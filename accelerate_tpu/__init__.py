@@ -1,0 +1,22 @@
+"""accelerate_tpu — TPU-native training orchestration.
+
+A ground-up JAX/XLA rebuild of the capability surface of HF Accelerate
+(reference at /root/reference): run any training loop on any TPU topology with
+sharding (DP/FSDP/TP/SP/PP/EP over one device mesh), mixed precision,
+gradient accumulation, checkpointing, big-model inference, and a launcher CLI.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .logging import get_logger
+from .utils import (
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    ModelParallelPlugin,
+    ParallelismConfig,
+    ProjectConfiguration,
+    find_executable_batch_size,
+    set_seed,
+)

@@ -1,0 +1,32 @@
+"""`accelerate-tpu` console entry point — subcommand router.
+
+Parity: reference commands/accelerate_cli.py:26-46. Subcommands are registered
+lazily so `--help` stays fast and optional deps stay optional.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", description="TPU-native training orchestration CLI", usage="accelerate-tpu <command> [<args>]"
+    )
+    subparsers = parser.add_subparsers(dest="command")
+
+    from . import config, env, estimate, launch, test
+
+    for module in (config, env, estimate, launch, test):
+        module.register_subcommand(subparsers)
+
+    args = parser.parse_args(argv)
+    if not hasattr(args, "func"):
+        parser.print_help()
+        return 1
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

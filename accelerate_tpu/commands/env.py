@@ -1,0 +1,35 @@
+"""`accelerate-tpu env` — environment report (parity: reference commands/env.py)."""
+
+from __future__ import annotations
+
+import os
+import platform
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("env", help="Print environment information for bug reports")
+    parser.set_defaults(func=run)
+    return parser
+
+
+def run(args) -> int:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "jax version": jax.__version__,
+        "jax backend": jax.default_backend(),
+        "device count": jax.device_count(),
+        "process count": jax.process_count(),
+        "devices": ", ".join(str(d) for d in jax.devices()[:8]) + ("..." if jax.device_count() > 8 else ""),
+    }
+    accelerate_env = {k: v for k, v in sorted(os.environ.items()) if k.startswith("ACCELERATE_")}
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        print(f"- {key}: {value}")
+    print(f"- ACCELERATE_* env: {accelerate_env or '{}'}")
+    return 0

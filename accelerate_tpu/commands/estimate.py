@@ -1,0 +1,52 @@
+"""`accelerate-tpu estimate-memory` — static memory estimate for a model.
+
+Parity: reference commands/estimate.py:215-299 (meta-device model → per-dtype
+table). Here the abstract init is `jax.eval_shape`, which is exact and free:
+no weights are materialized.
+"""
+
+from __future__ import annotations
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "estimate-memory", help="Estimate device memory for training/inference of a model"
+    )
+    parser.add_argument("model_name", help="Built-in model name (e.g. llama-7b, bert-base) or params=N")
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8"])
+    parser.set_defaults(func=run)
+    return parser
+
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5, "fp8": 1}
+
+
+def _convert_bytes(size: float) -> str:
+    for unit in ["B", "KB", "MB", "GB", "TB"]:
+        if size < 1024:
+            return f"{size:.2f} {unit}"
+        size /= 1024
+    return f"{size:.2f} PB"
+
+
+def count_params(model_name: str) -> int:
+    if model_name.startswith("params="):
+        return int(float(model_name.split("=", 1)[1]))
+    from ..models import get_config, param_count
+
+    return param_count(get_config(model_name))
+
+
+def run(args) -> int:
+    n = count_params(args.model_name)
+    print(f"Model: {args.model_name} — {n / 1e9:.2f}B parameters")
+    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}"
+    print(header)
+    print("-" * len(header))
+    for dtype in args.dtypes:
+        b = _DTYPE_BYTES[dtype]
+        params = n * b
+        # grads stored in the same dtype; Adam keeps two fp32 moments + fp32 master params
+        train = params + n * b + n * 4 * 3
+        print(f"{dtype:>10} | {_convert_bytes(params):>10} | {_convert_bytes(params * 2):>10} | {_convert_bytes(train):>14}")
+    return 0

@@ -1,0 +1,90 @@
+"""`accelerate-tpu launch` — run a training script with the configured topology.
+
+Parity: reference commands/launch.py (arg parser 135-678, _validate_launch_command
+891, launchers 681-888). Structural difference: JAX runs ONE process per host
+that drives every local chip, so there is no torchrun/xmp.spawn process tree —
+launch = set ACCELERATE_* env + exec the script. Multi-host pods run this same
+command on every host (process_id differs), exactly how `jax.distributed`
+expects to be bootstrapped.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from .config import load_config_from_file
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("launch", help="Launch a training script on this host's devices")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--num_processes", type=int, default=None, help="Total number of hosts in the job")
+    parser.add_argument("--process_id", type=int, default=None, help="This host's index (multi-host)")
+    parser.add_argument("--coordinator_address", default=None, help="host:port of process 0 (multi-host)")
+    parser.add_argument("--data_parallel_size", type=int, default=None)
+    parser.add_argument("--fsdp_size", type=int, default=None)
+    parser.add_argument("--tensor_size", type=int, default=None)
+    parser.add_argument("--sequence_size", type=int, default=None)
+    parser.add_argument("--pipeline_size", type=int, default=None)
+    parser.add_argument("--expert_size", type=int, default=None)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--debug", action="store_true", help="Enable debug-mode collective verification")
+    parser.add_argument("-m", "--module", action="store_true", help="Treat script as a python module")
+    parser.add_argument("training_script", help="Script (or module) to launch")
+    parser.add_argument("training_script_args", nargs=argparse_remainder(), help="Arguments for the script")
+    parser.set_defaults(func=run)
+    return parser
+
+
+def argparse_remainder():
+    import argparse
+
+    return argparse.REMAINDER
+
+
+def build_env(args) -> dict[str, str]:
+    """Resolution order: CLI flag > existing env > YAML config > default."""
+    config = load_config_from_file(args.config_file)
+    par = config.get("parallelism", {}) or {}
+    env = dict(os.environ)
+
+    def put(key: str, cli_value, config_value=None):
+        if cli_value is not None:
+            env[key] = str(cli_value)
+        elif key not in env and config_value is not None:
+            env[key] = str(config_value)
+
+    put("ACCELERATE_MIXED_PRECISION", args.mixed_precision, config.get("mixed_precision"))
+    put("ACCELERATE_NUM_PROCESSES", args.num_processes, config.get("num_processes"))
+    put("ACCELERATE_PROCESS_ID", args.process_id)
+    put("ACCELERATE_COORDINATOR_ADDRESS", args.coordinator_address, config.get("coordinator_address"))
+    put("ACCELERATE_DATA_PARALLEL_SIZE", args.data_parallel_size, par.get("data"))
+    put("ACCELERATE_FSDP_SIZE", args.fsdp_size, par.get("fsdp"))
+    put("ACCELERATE_TENSOR_SIZE", args.tensor_size, par.get("tensor"))
+    put("ACCELERATE_SEQUENCE_SIZE", args.sequence_size, par.get("sequence"))
+    put("ACCELERATE_PIPELINE_SIZE", args.pipeline_size, par.get("pipeline"))
+    put("ACCELERATE_EXPERT_SIZE", args.expert_size, par.get("expert"))
+    put(
+        "ACCELERATE_GRADIENT_ACCUMULATION_STEPS",
+        args.gradient_accumulation_steps,
+        config.get("gradient_accumulation_steps"),
+    )
+    put("ACCELERATE_SEED", None, config.get("seed"))
+    if args.debug:
+        env["ACCELERATE_DEBUG_MODE"] = "1"
+    return env
+
+
+def run(args) -> int:
+    env = build_env(args)
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m", args.training_script]
+    else:
+        cmd += [args.training_script]
+    cmd += args.training_script_args
+    completed = subprocess.run(cmd, env=env)
+    return completed.returncode

@@ -1,0 +1,31 @@
+"""`accelerate-tpu test` — run the bundled sanity script through the launcher.
+
+Parity: reference commands/test.py:65.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("test", help="Run a sanity check of the install/topology")
+    parser.add_argument("--config_file", default=None)
+    parser.set_defaults(func=run)
+    return parser
+
+
+def run(args) -> int:
+    from .. import test_utils
+
+    script = os.path.join(os.path.dirname(test_utils.__file__), "scripts", "test_script.py")
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.cli", "launch"]
+    if args.config_file:
+        cmd += ["--config_file", args.config_file]
+    cmd += [script]
+    result = subprocess.run(cmd)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return result.returncode

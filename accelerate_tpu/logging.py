@@ -1,0 +1,60 @@
+"""Multi-process-aware logging.
+
+Parity: reference logging.py (MultiProcessAdapter:38, get_logger:83,
+warning_once:71, level from env:117).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logs only on the main process unless ``main_process_only=False``.
+
+    ``in_order=True`` emits from each process in process-index order (each host
+    waits for the ones before it) — useful for debugging per-host state.
+    """
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        return not main_process_only or PartialState().is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        from .state import PartialState
+
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        if not self.isEnabledFor(level):
+            return
+        if in_order:
+            # Every process participates in the same barrier sequence
+            # (otherwise hosts would deadlock on mismatched collective counts),
+            # logging only on its turn. in_order implies all processes log.
+            state = PartialState()
+            for i in range(state.num_processes):
+                if i == state.process_index:
+                    pmsg, pkwargs = self.process(msg, kwargs)
+                    self.logger.log(level, pmsg, *args, **pkwargs)
+                state.wait_for_everyone()
+        elif self._should_log(main_process_only):
+            msg, kwargs = self.process(msg, kwargs)
+            self.logger.log(level, msg, *args, **kwargs)
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,1 @@
+from .config import TransformerConfig, get_config, list_models, param_count, register_config

@@ -1,0 +1,131 @@
+"""Model configurations and the built-in registry.
+
+The model zoo is pure JAX: parameters are pytrees of jnp arrays, models are
+(init, apply) function pairs. This keeps abstract init (`jax.eval_shape`),
+partition-rule matching (by pytree path), and checkpoint IO trivial — no
+module-system indirection between the framework and XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """One config for both decoder (llama-style) and encoder (bert-style) stacks."""
+
+    arch: str = "llama"  # "llama" | "bert"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # grouped-query attention; None = num_heads
+    head_dim: Optional[int] = None  # None = hidden_size // num_heads
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # encoder-only extras
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    dropout_rate: float = 0.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def replace(self, **kwargs) -> "TransformerConfig":
+        return replace(self, **kwargs)
+
+
+_REGISTRY: dict[str, TransformerConfig] = {
+    # llama family (decoder)
+    "llama-tiny": TransformerConfig(
+        arch="llama", vocab_size=1024, hidden_size=128, intermediate_size=352,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+    ),
+    "llama-125m": TransformerConfig(
+        arch="llama", vocab_size=32000, hidden_size=768, intermediate_size=2048,
+        num_layers=12, num_heads=12, max_seq_len=2048,
+    ),
+    "llama-1b": TransformerConfig(
+        arch="llama", vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_layers=22, num_heads=16, max_seq_len=2048,
+    ),
+    "llama-7b": TransformerConfig(
+        arch="llama", vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, max_seq_len=4096,
+    ),
+    "llama-13b": TransformerConfig(
+        arch="llama", vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+        num_layers=40, num_heads=40, max_seq_len=4096,
+    ),
+    "llama-70b": TransformerConfig(
+        arch="llama", vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=4096,
+    ),
+    # bert family (encoder) — nlp_example parity (BERT-base MRPC)
+    "bert-tiny": TransformerConfig(
+        arch="bert", vocab_size=1024, hidden_size=128, intermediate_size=512,
+        num_layers=2, num_heads=2, max_seq_len=128,
+    ),
+    "bert-base": TransformerConfig(
+        arch="bert", vocab_size=30522, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=512, norm_eps=1e-12,
+    ),
+    "bert-large": TransformerConfig(
+        arch="bert", vocab_size=30522, hidden_size=1024, intermediate_size=4096,
+        num_layers=24, num_heads=16, max_seq_len=512, norm_eps=1e-12,
+    ),
+}
+
+
+def get_config(name: str) -> TransformerConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def register_config(name: str, config: TransformerConfig) -> None:
+    _REGISTRY[name] = config
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def param_count(config: TransformerConfig) -> int:
+    """Exact parameter count without materializing anything."""
+    h, i, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    d, nh, nkv = config.dim_per_head, config.num_heads, config.kv_heads
+    if config.arch == "llama":
+        per_layer = (
+            h * (nh * d)          # q
+            + 2 * h * (nkv * d)   # k, v
+            + (nh * d) * h        # o
+            + 3 * h * i           # gate, up, down
+            + 2 * h               # two rmsnorms
+        )
+        total = v * h + config.num_layers * per_layer + h  # embed + layers + final norm
+        if not config.tie_embeddings:
+            total += h * v  # lm head
+        return total
+    if config.arch == "bert":
+        embed = v * h + config.max_seq_len * h + config.type_vocab_size * h + 2 * h
+        per_layer = (
+            4 * (h * h + h)       # q,k,v,o with bias
+            + h * i + i           # mlp up
+            + i * h + h           # mlp down
+            + 4 * h               # two layernorms (scale+bias)
+        )
+        pooler = h * h + h
+        classifier = h * config.num_labels + config.num_labels
+        return embed + config.num_layers * per_layer + pooler + classifier
+    raise ValueError(f"unknown arch {config.arch}")

@@ -1,0 +1,470 @@
+"""Tree-recursive tensor utilities and host-level collectives.
+
+Parity: reference utils/operations.py (recursively_apply:84, send_to_device:135,
+gather:308-441, gather_object:451, broadcast:545, broadcast_object_list:566,
+reduce:727, pad_across_processes:634, concatenate:607, slice_tensors:587,
+convert_outputs_to_fp32:818, verify_operation:370).
+
+Semantics shift: the reference's collectives move per-rank tensors through
+NCCL/xm at every call. Here there are two distinct worlds:
+
+1. **Inside jit** nothing in this file is needed — sharding annotations make
+   XLA emit ICI collectives.
+2. **Outside jit (this file)** data is either a *global* ``jax.Array`` (already
+   the result of an SPMD computation — "gather" just means fetch/replicate) or
+   *host-local* numpy (per-host loader output, metrics — "gather" means
+   all-gather across hosts via ``multihost_utils``).
+
+Every function is recursive over nested list/tuple/dict/namedtuple trees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..state import PartialState
+
+
+class DistributedOperationException(Exception):
+    """Raised by debug-mode verification when per-host operands disagree."""
+
+
+# ---------------------------------------------------------------------------
+# tree recursion
+# ---------------------------------------------------------------------------
+
+
+def honor_type(obj, generator):
+    """Rebuild ``obj``'s container type (incl. namedtuples) from ``generator``."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) and not isinstance(x, np.generic)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_tensor,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every leaf of a nested container passing ``test_type``."""
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (
+                recursively_apply(
+                    func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for o in data
+            ),
+        )
+    if isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for k, v in data.items()
+            }
+        )
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to {getattr(func, '__name__', func)}; only nested "
+            "list/tuple/dict of arrays are supported."
+        )
+    return data
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def send_to_device(tensor, device=None, non_blocking: bool = False, skip_keys=None):
+    """Recursively place arrays on ``device`` (a Device or NamedSharding).
+
+    ``device=None`` targets the batch sharding of the active mesh — the usual
+    case for training batches. ``skip_keys`` mirrors the reference's API for
+    dict entries that should stay on host.
+    """
+    if device is None:
+        device = PartialState().data_sharding()
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+
+    def _send(t):
+        target = device
+        if isinstance(target, jax.sharding.NamedSharding):
+            # Leaves that can't split evenly over the batch axes (scalars,
+            # odd-length metadata) are replicated instead.
+            entry = target.spec[0] if len(target.spec) else None
+            axes = (entry,) if isinstance(entry, str) else (entry or ())
+            split = 1
+            for axis in axes:
+                split *= target.mesh.shape[axis]
+            if t.ndim == 0 or (split > 1 and t.shape[0] % split != 0):
+                target = jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec())
+        return jax.device_put(t, target)
+
+    if skip_keys:
+        # skip_keys applies at every Mapping level of the tree (reference
+        # operations.py:178,187), so recurse manually through containers.
+        if isinstance(tensor, Mapping):
+            return type(tensor)(
+                {
+                    k: (v if k in skip_keys else send_to_device(v, device, skip_keys=skip_keys))
+                    for k, v in tensor.items()
+                }
+            )
+        if isinstance(tensor, (tuple, list)):
+            return honor_type(tensor, (send_to_device(v, device, skip_keys=skip_keys) for v in tensor))
+    return recursively_apply(_send, tensor)
+
+
+def to_numpy(tensor):
+    """Fetch every leaf to host numpy (fully replicating sharded arrays)."""
+
+    def _get(t):
+        if isinstance(t, jax.Array) and not t.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(t, tiled=True))
+        return np.asarray(t)
+
+    return recursively_apply(_get, tensor)
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+
+def find_device(data):
+    """First device found in the tree (reference operations.py:830)."""
+    if isinstance(data, Mapping):
+        for v in data.values():
+            d = find_device(v)
+            if d is not None:
+                return d
+    elif isinstance(data, (tuple, list)):
+        for v in data:
+            d = find_device(v)
+            if d is not None:
+                return d
+    elif isinstance(data, jax.Array):
+        return next(iter(data.devices()))
+    return None
+
+
+def find_batch_size(data):
+    """Leading-dim size of the first array leaf (reference operations.py:254)."""
+    if isinstance(data, Mapping):
+        for v in data.values():
+            b = find_batch_size(v)
+            if b is not None:
+                return b
+    elif isinstance(data, (tuple, list)):
+        for v in data:
+            b = find_batch_size(v)
+            if b is not None:
+                return b
+    elif is_tensor(data) and data.ndim >= 1:
+        return data.shape[0]
+    return None
+
+
+def get_shape(data):
+    return recursively_apply(lambda t: list(t.shape), data)
+
+
+def get_data_structure(data):
+    """Shape+dtype skeleton used to rebuild trees across hosts (operations.py:244)."""
+    from ..utils.dataclasses import TensorInformation
+
+    return recursively_apply(lambda t: TensorInformation(shape=tuple(t.shape), dtype=t.dtype), data)
+
+
+def initialize_tensors(data_structure):
+    from ..utils.dataclasses import TensorInformation
+
+    return recursively_apply(
+        lambda ti: np.empty(ti.shape, dtype=ti.dtype),
+        data_structure,
+        test_type=lambda x: isinstance(x, TensorInformation),
+    )
+
+
+def listify(data):
+    """Arrays → nested python lists (reference operations.py:203)."""
+    return recursively_apply(lambda t: np.asarray(t).tolist(), data)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    return recursively_apply(lambda t: t[tensor_slice], data)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of same-structure trees leafwise (operations.py:607)."""
+    first = data[0]
+    if isinstance(first, (tuple, list)):
+        return honor_type(first, (concatenate([d[i] for d in data], dim=dim) for i in range(len(first))))
+    if isinstance(first, Mapping):
+        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first.keys()})
+    if isinstance(first, jax.Array):
+        return jnp.concatenate(data, axis=dim)
+    return np.concatenate(data, axis=dim)
+
+
+# ---------------------------------------------------------------------------
+# debug-mode operation verification (reference operations.py:370-421, §5.2)
+# ---------------------------------------------------------------------------
+
+
+def _verify_same_shapes(operation: str, tensor) -> None:
+    state = PartialState()
+    if not state.debug or state.num_processes == 1:
+        return
+    shapes = gather_object([get_shape(tensor)])
+    if any(s != shapes[0] for s in shapes):
+        table = "\n".join(f"  - Process {i}: {s}" for i, s in enumerate(shapes))
+        raise DistributedOperationException(
+            f"Cannot apply the desired operation ({operation}) due to shape mismatches across processes:\n{table}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# host-level collectives
+# ---------------------------------------------------------------------------
+
+
+def _is_global_jax_array(t) -> bool:
+    return isinstance(t, jax.Array) and len(t.sharding.device_set) > 1
+
+
+def gather(tensor):
+    """All-gather across the data dimension.
+
+    - global sharded ``jax.Array``: replicate + fetch (the array already *is*
+      the global batch; XLA's all-gather happens in ``to_numpy``).
+    - host-local array in a multi-host job: concat every host's copy along the
+      leading axis (reference all_gather semantics).
+    """
+    _verify_same_shapes("gather", tensor)
+    state = PartialState()
+
+    def _gather(t):
+        if _is_global_jax_array(t):
+            return to_numpy(t)
+        if state.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(np.asarray(t), tiled=False)).reshape(
+                (-1,) + tuple(t.shape[1:])
+            )
+        return np.asarray(t)
+
+    return recursively_apply(_gather, tensor, error_on_other_type=True)
+
+
+def gather_object(obj: list):
+    """Gather a list of picklable objects from every host (operations.py:451).
+
+    One padded ``process_allgather`` round regardless of host count: each host
+    contributes (size, pickled-bytes) padded to the global max.
+    """
+    import pickle
+
+    state = PartialState()
+    if state.num_processes == 1:
+        return list(obj)
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(pickle.dumps(list(obj)), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(np.array([blob.size], dtype=np.int64))
+    max_size = int(np.max(sizes))
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: blob.size] = blob
+    blobs = multihost_utils.process_allgather(padded)
+    gathered = []
+    for p in range(state.num_processes):
+        gathered.extend(pickle.loads(bytes(bytearray(np.asarray(blobs[p][: int(sizes[p][0])])))))
+    return gathered
+
+
+def _broadcast_py(obj, src: int = 0):
+    """Broadcast an arbitrary picklable object from host ``src``."""
+    import pickle
+
+    state = PartialState()
+    if state.num_processes == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    if state.process_index == src:
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        size = np.array([blob.size], dtype=np.int64)
+    else:
+        blob = None
+        size = np.zeros(1, dtype=np.int64)
+    # Two rounds: size, then payload. broadcast_one_to_all only sends from
+    # process 0, so for src != 0 we route through an allgather.
+    if src == 0:
+        size = multihost_utils.broadcast_one_to_all(size)
+        buf = blob if blob is not None else np.zeros(int(size[0]), dtype=np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf)
+    else:
+        sizes = multihost_utils.process_allgather(size)
+        size = sizes[src]
+        buf_local = blob if blob is not None else np.zeros(int(size[0]), dtype=np.uint8)
+        pad = np.zeros(int(np.max(sizes)), dtype=np.uint8)
+        pad[: buf_local.size] = buf_local
+        bufs = multihost_utils.process_allgather(pad)
+        buf = bufs[src][: int(size[0])]
+    return pickle.loads(bytes(bytearray(np.asarray(buf))))
+
+
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast each array leaf from ``from_process`` (operations.py:545)."""
+    _verify_same_shapes("broadcast", tensor)
+    state = PartialState()
+    if state.num_processes == 1:
+        return tensor
+
+    def _bcast(t):
+        return _broadcast_py(np.asarray(t), src=from_process)
+
+    return recursively_apply(_bcast, tensor, error_on_other_type=True)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """In-place broadcast of a list of objects (operations.py:566)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return object_list
+    received = _broadcast_py(list(object_list), src=from_process)
+    object_list[:] = received
+    return object_list
+
+
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Sum/mean each leaf across hosts (operations.py:727).
+
+    Semantics per leaf kind:
+    - host-local numpy in a multi-host job: true cross-host reduction (the
+      reference's per-rank all_reduce).
+    - global ``jax.Array`` (sharded or replicated): the leaf already *is* one
+      logical global value produced under SPMD — there is nothing left to
+      reduce, so it is fetched as-is (``reduction`` does not multiply by the
+      host count; that would double-count replication).
+    """
+    state = PartialState()
+
+    def _reduce(t):
+        if _is_global_jax_array(t):
+            arr = to_numpy(t)
+        elif state.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = np.asarray(multihost_utils.process_allgather(np.asarray(t), tiled=False))
+            arr = stacked.sum(axis=0)
+            if reduction == "mean":
+                arr = arr / state.num_processes
+            return arr * scale
+        else:
+            arr = np.asarray(t)
+        return arr * scale
+
+    return recursively_apply(_reduce, tensor, error_on_other_type=True)
+
+
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each host's array to the max size along ``dim`` (operations.py:634)."""
+    state = PartialState()
+
+    def _pad(t):
+        t = np.asarray(t)
+        if t.ndim == 0 or state.num_processes == 1:
+            return t
+        sizes = gather_object([t.shape[dim]])
+        max_size = max(sizes)
+        if t.shape[dim] == max_size:
+            return t
+        new_shape = list(t.shape)
+        new_shape[dim] = max_size
+        out = np.full(new_shape, pad_index, dtype=t.dtype)
+        idx = [slice(None)] * t.ndim
+        if pad_first:
+            idx[dim] = slice(max_size - t.shape[dim], max_size)
+        else:
+            idx[dim] = slice(0, t.shape[dim])
+        out[tuple(idx)] = t
+        return out
+
+    return recursively_apply(_pad, tensor, error_on_other_type=True)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad batch to divisibility by num_processes (operations.py:686)."""
+
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tensor
+    pad_count = num_processes - remainder
+
+    def _pad(t):
+        t = np.asarray(t)
+        if t.shape[dim] != batch_size:
+            return t
+        reps = [1] * t.ndim
+        reps[dim] = pad_count
+        tail = np.take(t, [-1], axis=dim)
+        return np.concatenate([t, np.tile(tail, reps)], axis=dim)
+
+    return recursively_apply(_pad, tensor, error_on_other_type=True)
+
+
+# ---------------------------------------------------------------------------
+# dtype conversion (reference operations.py:768-827)
+# ---------------------------------------------------------------------------
+
+
+def convert_to_fp32(tensor):
+    def _upcast(t):
+        if hasattr(t, "dtype") and t.dtype in (jnp.float16, jnp.bfloat16):
+            return t.astype(jnp.float32) if isinstance(t, jax.Array) else np.asarray(t, dtype=np.float32)
+        return t
+
+    return recursively_apply(_upcast, tensor)
+
+
+class ConvertOutputsToFp32:
+    """Pickleable callable wrapper upcasting a function's outputs to fp32."""
+
+    def __init__(self, model_forward: Callable):
+        self.model_forward = model_forward
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+    def __getstate__(self):
+        return {"model_forward": self.model_forward}
+
+    def __setstate__(self, state):
+        self.model_forward = state["model_forward"]
+
+
+def convert_outputs_to_fp32(model_forward: Callable) -> Callable:
+    return ConvertOutputsToFp32(model_forward)

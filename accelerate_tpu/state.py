@@ -1,0 +1,402 @@
+"""Process/topology/mesh state singletons.
+
+Parity: reference state.py — PartialState (111), AcceleratorState (808),
+GradientState (1085). The reference's PartialState must pick among nine
+communication backends and bind one device per OS process; here there is
+exactly one backend (the JAX runtime) and one process per *host* driving all
+of that host's TPU chips. The "distributed environment" is therefore:
+
+    control plane:  jax.distributed (coordination service, one proc/host)
+    data plane:     a jax.sharding.Mesh over every device in the job; all
+                    collectives are emitted by XLA from sharding annotations
+
+The Borg pattern (shared ``_shared_state`` dict) is kept so every component
+sees one consistent topology without plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from functools import wraps
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+import jax
+
+from .logging import get_logger
+from .utils.constants import CANONICAL_MESH_AXES, MESH_AXIS_DATA
+from .utils.dataclasses import (
+    DistributedType,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ParallelismConfig,
+    PrecisionType,
+)
+from .utils.environment import get_multihost_env, parse_flag_from_env
+
+logger = get_logger(__name__)
+
+
+def is_initialized() -> bool:
+    """Whether AcceleratorState has been constructed (reference state.py:66)."""
+    return AcceleratorState._shared_state != {}
+
+
+class PartialState:
+    """Topology bootstrap singleton.
+
+    Responsibilities (mapping reference state.py:111-805):
+    - multi-host rendezvous: ``jax.distributed.initialize`` when env coordinates
+      are present (replaces init_process_group / xm.set_replication).
+    - expose process_index / num_processes / local device list.
+    - build the global device Mesh from a ParallelismConfig.
+    - process-control helpers: wait_for_everyone, split_between_processes,
+      main_process_first, on_main_process/on_last_process/on_process decorators.
+    """
+
+    _shared_state: dict[str, Any] = {}
+    _mutex = threading.Lock()
+
+    def __init__(self, parallelism: Optional[ParallelismConfig] = None, **kwargs: Any) -> None:
+        with PartialState._mutex:
+            self.__dict__ = PartialState._shared_state
+            if self.initialized:
+                if parallelism is not None and parallelism != self.parallelism:
+                    raise ValueError(
+                        "PartialState is already initialized with a different ParallelismConfig; "
+                        "call PartialState._reset_state() first (tests) or construct it once."
+                    )
+                return
+            self._bootstrap_distributed(**kwargs)
+            self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+            self.parallelism = parallelism or ParallelismConfig.from_env()
+            self._build_mesh()
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def _bootstrap_distributed(self, **kwargs: Any) -> None:
+        env = get_multihost_env()
+        coordinator = kwargs.get("coordinator_address", env["coordinator_address"])
+        num_processes = kwargs.get("num_processes", env["num_processes"])
+        process_id = kwargs.get("process_id", env["process_id"])
+        if coordinator and (num_processes or 0) > 1:
+            # PROCESS BOUNDARY: every host blocks here until the whole job
+            # has rendezvoused with the coordinator (replaces the reference's
+            # MASTER_ADDR/MASTER_PORT TCPStore rendezvous, state.py:213).
+            # Probing jax.process_count() first would initialize the local
+            # backend and defeat distributed init, so ask the distributed
+            # module itself whether it is live.
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+        self.backend = "xla"
+        self.device = jax.local_devices()[0]
+        self.initialized = True
+
+    def _build_mesh(self) -> None:
+        devices = jax.devices()
+        axis_sizes = self.parallelism.axis_sizes(len(devices))
+        shape = tuple(axis_sizes[a] for a in CANONICAL_MESH_AXES)
+        # mesh_utils lays devices out to keep inner axes on the fastest ICI links.
+        try:
+            from jax.experimental import mesh_utils
+
+            device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:  # CPU meshes / odd shapes: plain reshape is fine
+            device_array = np.asarray(devices).reshape(shape)
+        self.mesh = jax.sharding.Mesh(device_array, CANONICAL_MESH_AXES)
+
+    # -- topology properties ----------------------------------------------
+
+    @property
+    def initialized(self) -> bool:
+        return self._shared_state.get("_ready", False)
+
+    @initialized.setter
+    def initialized(self, value: bool) -> None:
+        self._shared_state["_ready"] = value
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def local_process_index(self) -> int:
+        # One process per host: the local index is always 0. Kept for API parity.
+        return 0
+
+    @property
+    def num_devices(self) -> int:
+        return jax.device_count()
+
+    @property
+    def local_devices(self):
+        return jax.local_devices()
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return True  # one process per host
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.process_index == self.num_processes - 1
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.num_devices > 1
+
+    @property
+    def distributed_type(self) -> DistributedType:
+        if self.num_devices == 1:
+            return DistributedType.NO
+        return self.parallelism.distributed_type
+
+    def data_sharding(self, extra_batch_axes: tuple[str, ...] = ()) -> jax.sharding.NamedSharding:
+        """Sharding for a batch: leading dim split over data-like axes."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_axes = (MESH_AXIS_DATA, "fsdp") + extra_batch_axes
+        present = tuple(a for a in batch_axes if a in self.mesh.shape)
+        return NamedSharding(self.mesh, PartitionSpec(present))
+
+    # -- process control ---------------------------------------------------
+
+    def wait_for_everyone(self) -> None:
+        """Block until all hosts reach this point (reference state.py:348)."""
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+
+    @contextmanager
+    def main_process_first(self):
+        """Main host runs the body first, the rest afterwards (state.py:484)."""
+        if not self.is_main_process:
+            self.wait_for_everyone()
+        yield
+        if self.is_main_process:
+            self.wait_for_everyone()
+
+    @contextmanager
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        """Yield this host's slice of ``inputs`` (reference state.py:393-481).
+
+        Supports lists/tuples/dicts-of-lists and numpy/jax arrays. With
+        ``apply_padding`` the last host's share is padded (repeating the final
+        element) so every host yields equally many items — required when the
+        results feed a collective.
+        """
+        if self.num_processes == 1:
+            yield inputs
+            return
+        length = len(inputs) if not isinstance(inputs, dict) else len(next(iter(inputs.values())))
+        base, extra = divmod(length, self.num_processes)
+        sizes = [base + (1 if p < extra else 0) for p in range(self.num_processes)]
+        start = sum(sizes[: self.process_index])
+        end = start + sizes[self.process_index]
+
+        def _slice(seq):
+            piece = seq[start:end]
+            if apply_padding and len(piece) < max(sizes) and len(seq):
+                pad_count = max(sizes) - len(piece)
+                if isinstance(piece, (np.ndarray, jax.Array)):
+                    xp = jax.numpy if isinstance(piece, jax.Array) else np
+                    tail = xp.repeat(seq[-1:], pad_count, axis=0)
+                    piece = xp.concatenate([piece, tail])
+                elif isinstance(piece, tuple):
+                    piece = piece + (seq[-1],) * pad_count
+                else:
+                    piece = list(piece) + [seq[-1]] * pad_count
+            return piece
+
+        if isinstance(inputs, dict):
+            yield {k: _slice(v) for k, v in inputs.items()}
+        else:
+            yield _slice(inputs)
+
+    def on_main_process(self, function: Callable) -> Callable:
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_main_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_last_process(self, function: Callable) -> Callable:
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_last_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_process(self, function: Callable | None = None, process_index: int = 0) -> Callable:
+        def decorator(fn):
+            @wraps(fn)
+            def wrapper(*args, **kwargs):
+                if self.process_index == process_index:
+                    return fn(*args, **kwargs)
+
+            return wrapper
+
+        return decorator(function) if function is not None else decorator
+
+    def print(self, *args, **kwargs) -> None:
+        if self.is_main_process:
+            print(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return (
+            f"PartialState(num_processes={self.num_processes}, process_index={self.process_index}, "
+            f"num_devices={self.num_devices}, mesh={dict(self.mesh.shape)}, "
+            f"distributed_type={self.distributed_type})"
+        )
+
+    @classmethod
+    def _reset_state(cls) -> None:
+        """Test hygiene: drop the Borg dict (reference testing.py:419-431)."""
+        cls._shared_state.clear()
+
+
+class AcceleratorState:
+    """PartialState + precision/plugin state (reference state.py:808).
+
+    Shares the PartialState dict for topology and layers mixed-precision policy
+    and the active plugins on top.
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(
+        self,
+        mixed_precision: str | None = None,
+        parallelism: Optional[ParallelismConfig] = None,
+        **kwargs: Any,
+    ) -> None:
+        self.__dict__ = AcceleratorState._shared_state
+        self._partial = PartialState(parallelism=parallelism, **kwargs)
+        if not getattr(self, "_as_ready", False):
+            if mixed_precision is None:
+                mixed_precision = os.environ.get("ACCELERATE_MIXED_PRECISION", "no")
+            self.precision_policy = MixedPrecisionPolicy(PrecisionType(mixed_precision))
+            self._as_ready = True
+        elif mixed_precision is not None and mixed_precision != self.mixed_precision:
+            raise ValueError(
+                f"AcceleratorState is already initialized with mixed_precision="
+                f"{self.mixed_precision!r}; got conflicting {mixed_precision!r}. "
+                "Call AcceleratorState._reset_state() first (tests) or construct it once."
+            )
+
+    # Topology is delegated so there is a single source of truth.
+    def __getattr__(self, name: str):
+        partial = self.__dict__.get("_partial")
+        if partial is not None and hasattr(partial, name):
+            return getattr(partial, name)
+        raise AttributeError(name)
+
+    @property
+    def mixed_precision(self) -> str:
+        return self.precision_policy.mixed_precision.value
+
+    def __repr__(self) -> str:
+        return f"{self._partial!r} mixed_precision={self.mixed_precision}"
+
+    @classmethod
+    def _reset_state(cls, reset_partial_state: bool = True) -> None:
+        cls._shared_state.clear()
+        if reset_partial_state:
+            PartialState._reset_state()
+
+
+class GradientState:
+    """Gradient-accumulation bookkeeping singleton (reference state.py:1085).
+
+    Tracks whether this step's gradients should be applied (``sync_gradients``)
+    and which prepared dataloaders are active so the final partial accumulation
+    window at end-of-epoch still steps (``sync_with_dataloader``).
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(self, gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None):
+        self.__dict__ = GradientState._shared_state
+        if not self.initialized:
+            self.sync_gradients = True
+            self.active_dataloader = None
+            self.dataloader_references: list = [None]
+            self.plugin_kwargs = {}
+            self._step = 0
+        if gradient_accumulation_plugin is not None:
+            self.plugin_kwargs = gradient_accumulation_plugin.to_kwargs()
+
+    @property
+    def initialized(self) -> bool:
+        return GradientState._shared_state != {}
+
+    @property
+    def num_steps(self) -> int:
+        return self.plugin_kwargs.get("num_steps", 1)
+
+    @property
+    def adjust_scheduler(self) -> bool:
+        return self.plugin_kwargs.get("adjust_scheduler", True)
+
+    @property
+    def sync_with_dataloader(self) -> bool:
+        return self.plugin_kwargs.get("sync_with_dataloader", True)
+
+    @property
+    def sync_each_batch(self) -> bool:
+        return self.plugin_kwargs.get("sync_each_batch", False)
+
+    @property
+    def end_of_dataloader(self) -> bool:
+        if not self.in_dataloader:
+            return False
+        return self.active_dataloader.end_of_dataloader
+
+    @property
+    def remainder(self) -> int:
+        if not self.in_dataloader:
+            return -1
+        return self.active_dataloader.remainder
+
+    @property
+    def in_dataloader(self) -> bool:
+        return self.active_dataloader is not None
+
+    def _add_dataloader(self, dataloader) -> None:
+        self.active_dataloader = dataloader
+        self.dataloader_references.append(dataloader)
+
+    def _remove_dataloader(self, dataloader) -> None:
+        if dataloader in self.dataloader_references:
+            self.dataloader_references.remove(dataloader)
+        self.active_dataloader = self.dataloader_references[-1]
+
+    def _set_sync_gradients(self, value: bool) -> None:
+        self.sync_gradients = value
+
+    def __repr__(self) -> str:
+        return (
+            f"GradientState(sync_gradients={self.sync_gradients}, num_steps={self.num_steps}, "
+            f"end_of_dataloader={self.end_of_dataloader}, remainder={self.remainder})"
+        )
+
+    @classmethod
+    def _reset_state(cls) -> None:
+        cls._shared_state.clear()

@@ -1,0 +1,9 @@
+from .testing import (
+    require_multi_device,
+    require_tpu,
+    skip,
+    DEFAULT_LAUNCH_COMMAND,
+    execute_subprocess,
+    get_launch_command,
+)
+from .training import RegressionDataset, RegressionModel

@@ -1,0 +1,47 @@
+"""Distributed sanity payload run by `accelerate-tpu test`.
+
+Parity: reference test_utils/scripts/test_script.py (the 802-LoC correctness
+suite) — this covers the topology/ops/RNG slice; training parity lives in the
+pytest suite (tests/test_accelerator.py).
+"""
+
+import numpy as np
+
+
+def main():
+    from accelerate_tpu import PartialState, set_seed
+    from accelerate_tpu import ops
+    from accelerate_tpu.utils import next_rng_key
+
+    state = PartialState()
+    state.print(f"Topology: {state!r}")
+
+    # ops roundtrip
+    batch = {"x": np.arange(8 * state.num_devices, dtype=np.float32).reshape(-1, 1)}
+    device_batch = ops.send_to_device(batch)
+    gathered = ops.gather(device_batch)
+    assert np.array_equal(gathered["x"], batch["x"]), "gather roundtrip failed"
+
+    # reduction
+    total = ops.reduce({"v": np.ones(3)}, "sum")
+    assert np.allclose(total["v"], state.num_processes * np.ones(3))
+
+    # seeded RNG determinism
+    set_seed(123)
+    k1 = next_rng_key()
+    set_seed(123)
+    k2 = next_rng_key()
+    import jax
+
+    assert (jax.random.key_data(k1) == jax.random.key_data(k2)).all()
+
+    # process-control
+    with state.split_between_processes(list(range(state.num_processes * 2))) as piece:
+        assert len(piece) == 2
+
+    state.wait_for_everyone()
+    state.print("All sanity checks passed.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Tiny regression model/dataset used by parity tests.
+
+Parity: reference test_utils/training.py (RegressionModel/RegressionDataset) —
+a y = a*x + b fit whose convergence is checked for exact agreement between
+single-device and distributed runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    def __init__(self, a: float = 2.0, b: float = 3.0, length: int = 64, seed: int = 42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + rng.normal(scale=0.1, size=(length,))).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, i: int) -> dict:
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+class RegressionModel:
+    """y_hat = a*x + b as a jax pytree model with an apply fn."""
+
+    def init(self, a0: float = 0.0, b0: float = 0.0) -> dict:
+        import jax.numpy as jnp
+
+        return {"a": jnp.asarray(a0, jnp.float32), "b": jnp.asarray(b0, jnp.float32)}
+
+    @staticmethod
+    def apply(params: dict, x):
+        return params["a"] * x + params["b"]
+
+    @staticmethod
+    def loss_fn(params: dict, batch: dict):
+        import jax.numpy as jnp
+
+        pred = RegressionModel.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)

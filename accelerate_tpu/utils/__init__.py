@@ -1,0 +1,55 @@
+from .constants import (
+    CANONICAL_MESH_AXES,
+    MESH_AXIS_DATA,
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_PIPELINE,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
+from .dataclasses import (
+    AutocastKwargs,
+    CompilationConfig,
+    ComputeEnvironment,
+    DistributedInitKwargs,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    KwargsHandler,
+    LossScaleKwargs,
+    MixedPrecisionPolicy,
+    ModelParallelPlugin,
+    ParallelismConfig,
+    PrecisionType,
+    ProjectConfiguration,
+    TensorInformation,
+)
+from .environment import (
+    clear_environment,
+    get_multihost_env,
+    parse_choice_from_env,
+    parse_flag_from_env,
+    parse_int_from_env,
+    patch_environment,
+    str_to_bool,
+)
+from .imports import (
+    is_datasets_available,
+    is_flax_available,
+    is_optax_available,
+    is_orbax_available,
+    is_safetensors_available,
+    is_tensorboard_available,
+    is_tpu_available,
+    is_transformers_available,
+    is_wandb_available,
+)
+from .memory import find_executable_batch_size, release_memory, should_reduce_batch_size
+from .random import (
+    next_rng_key,
+    restore_rng_state,
+    rng_state,
+    set_seed,
+    synchronize_rng_states,
+)
+from .versions import compare_versions, is_jax_version

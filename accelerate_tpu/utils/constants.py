@@ -1,0 +1,47 @@
+"""File-name and version constants used across the framework.
+
+Parity: reference utils/constants.py (file name constants, version floors).
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+RNG_STATE_NAME = "random_states"
+CUSTOM_OBJECT_NAME = "custom_checkpoint"
+
+# Sharded-array checkpoint format (our analogue of safetensors + index.json):
+# every host writes `<name>.shard_<p>.npz` plus a single `<name>.index.json`.
+WEIGHTS_NAME = f"{MODEL_NAME}.msgpack"
+WEIGHTS_INDEX_NAME = f"{MODEL_NAME}.index.json"
+SHARD_PATTERN = "{name}.shard_{process:05d}.npz"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+
+# Mesh axis names, in canonical (outer -> inner) order. ICI bandwidth is highest
+# on the innermost axes, so tensor/sequence (which carry per-layer collectives)
+# live innermost; data/fsdp (one collective per step) live outermost.
+MESH_AXIS_DATA = "data"
+MESH_AXIS_FSDP = "fsdp"
+MESH_AXIS_PIPELINE = "pipeline"
+MESH_AXIS_EXPERT = "expert"
+MESH_AXIS_SEQUENCE = "sequence"
+MESH_AXIS_TENSOR = "tensor"
+CANONICAL_MESH_AXES = (
+    MESH_AXIS_DATA,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_PIPELINE,
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
+
+# Env-var namespace. The launcher serializes config into these; library code
+# rehydrates them (resolution order: explicit kwarg > env > yaml > default).
+ENV_PREFIX = "ACCELERATE_"
+
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+# Default rendezvous for multi-host jax.distributed bootstrap.
+DEFAULT_COORDINATOR_PORT = 8476

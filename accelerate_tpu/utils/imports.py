@@ -1,0 +1,90 @@
+"""Soft-dependency probes (parity: reference utils/imports.py is_X_available registry).
+
+Everything optional is gated behind one of these so the core framework imports
+with only jax + numpy present.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache
+def _is_package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def is_flax_available() -> bool:
+    return _is_package_available("flax")
+
+
+def is_optax_available() -> bool:
+    return _is_package_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return _is_package_available("orbax")
+
+
+def is_safetensors_available() -> bool:
+    return _is_package_available("safetensors")
+
+
+def is_transformers_available() -> bool:
+    return _is_package_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return _is_package_available("datasets")
+
+
+def is_torch_available() -> bool:
+    return _is_package_available("torch")
+
+
+def is_tensorboard_available() -> bool:
+    return _is_package_available("tensorboard") or _is_package_available("tensorboardX")
+
+
+def is_wandb_available() -> bool:
+    return _is_package_available("wandb")
+
+
+def is_mlflow_available() -> bool:
+    return _is_package_available("mlflow")
+
+
+def is_comet_ml_available() -> bool:
+    return _is_package_available("comet_ml")
+
+
+def is_aim_available() -> bool:
+    return _is_package_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _is_package_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _is_package_available("dvclive")
+
+
+def is_rich_available() -> bool:
+    return _is_package_available("rich")
+
+
+def is_pandas_available() -> bool:
+    return _is_package_available("pandas")
+
+
+@lru_cache
+def is_tpu_available() -> bool:
+    """True when jax sees at least one real TPU device."""
+    import jax
+
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False

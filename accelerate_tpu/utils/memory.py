@@ -1,0 +1,77 @@
+"""OOM-adaptive execution helpers.
+
+Parity: reference utils/memory.py (release_memory:29, should_reduce_batch_size:69,
+find_executable_batch_size:87). The OOM classifier keys on XLA's
+RESOURCE_EXHAUSTED instead of CUDA out-of-memory strings.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable
+
+import jax
+
+
+def release_memory(*objects):
+    """Drop references, run gc, and free live jax buffers deleted this way."""
+    released = [None for _ in objects]
+    del objects
+    gc.collect()
+    return released if len(released) != 1 else released[0]
+
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "out of memory",
+    "OOM",
+    "Attempting to reserve",
+    "exceeds the maximum supported size",
+)
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Classify an exception as a device-memory exhaustion we can retry past."""
+    if isinstance(exception, jax.errors.JaxRuntimeError) or isinstance(exception, (RuntimeError, ValueError)):
+        text = str(exception)
+        return any(marker in text for marker in _OOM_MARKERS)
+    return False
+
+
+def find_executable_batch_size(
+    function: Callable | None = None, starting_batch_size: int = 128
+):
+    """Decorator that retries ``function(batch_size, ...)`` halving the batch on OOM.
+
+    Mirrors reference utils/memory.py:87-158 including the introspection error
+    when the wrapped function does not take ``batch_size`` first.
+    """
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size_box = {"value": starting_batch_size}
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        params = list(inspect.signature(function).parameters.keys())
+        if not params or params[0] != "batch_size":
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument, "
+                f"but `{function.__name__}({', '.join(params)})` does not accept `batch_size` first."
+            )
+        while True:
+            if batch_size_box["value"] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size_box["value"], *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - classifier decides
+                if should_reduce_batch_size(e):
+                    gc.collect()
+                    batch_size_box["value"] //= 2
+                else:
+                    raise
+
+    return wrapper

@@ -1,0 +1,116 @@
+"""Reproducible RNG utilities.
+
+Parity: reference utils/random.py (set_seed:31 with device_specific rank offset,
+synchronize_rng_states:64 broadcast-from-rank-0). The torch design has to
+*synchronize* mutable global RNG state across workers every epoch; JAX PRNG keys
+are values, so synchronization collapses to "derive everything from one root
+key". We keep a tiny process-global keystore so the eager-style API
+(``set_seed`` + ``next_rng_key``) still works, and the key is saved/restored by
+checkpointing.
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+
+import numpy as np
+
+import jax
+
+
+class _KeyStore:
+    """Process-global root PRNG key + monotonic fold counter."""
+
+    def __init__(self) -> None:
+        self.seed: int | None = None
+        self._key: jax.Array | None = None
+        self._count: int = 0
+
+    def set_seed(self, seed: int) -> None:
+        self.seed = seed
+        self._key = jax.random.key(seed)
+        self._count = 0
+
+    @property
+    def initialized(self) -> bool:
+        return self._key is not None
+
+    def next_key(self, num: int | None = None):
+        if self._key is None:
+            self.set_seed(0)
+        self._key, sub = jax.random.split(self._key)
+        self._count += 1
+        if num is None:
+            return sub
+        return jax.random.split(sub, num)
+
+    def state(self) -> dict:
+        return {"seed": self.seed, "count": self._count}
+
+    def restore(self, state: dict) -> None:
+        self.set_seed(state["seed"] if state["seed"] is not None else 0)
+        # Replay the fold count so the next key continues the saved stream.
+        for _ in range(state["count"]):
+            self._key, _ = jax.random.split(self._key)
+        self._count = state["count"]
+
+
+_KEYSTORE = _KeyStore()
+
+
+def set_seed(seed: int, device_specific: bool = False) -> None:
+    """Seed python, numpy and the jax keystore.
+
+    With ``device_specific=True`` the seed is offset by the process index so
+    each host draws distinct randomness (reference utils/random.py:40-44).
+    """
+    if device_specific:
+        from ..state import PartialState
+
+        seed += PartialState().process_index
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    _KEYSTORE.set_seed(seed)
+
+
+def next_rng_key(num: int | None = None):
+    """Split a fresh subkey (or ``num`` subkeys) off the process root key."""
+    return _KEYSTORE.next_key(num)
+
+
+def rng_state() -> dict:
+    """Checkpointable snapshot of python/numpy/jax RNG state."""
+    return {
+        "python": _py_random.getstate(),
+        "numpy": np.random.get_state(),
+        "jax_keystore": _KEYSTORE.state(),
+    }
+
+
+def restore_rng_state(state: dict) -> None:
+    _py_random.setstate(state["python"])
+    np.random.set_state(state["numpy"])
+    _KEYSTORE.restore(state["jax_keystore"])
+
+
+def synchronize_rng_states() -> None:
+    """Ensure every process derives from the same root key.
+
+    On torch this broadcasts mutable generator state (utils/random.py:64-124);
+    here all processes already share the root seed as long as ``set_seed`` was
+    called with the same value, so this only verifies/repairs the invariant by
+    broadcasting process 0's keystore counters.
+    """
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    payload = np.array(
+        [_KEYSTORE.seed if _KEYSTORE.seed is not None else 0, _KEYSTORE._count],
+        dtype=np.int64,
+    )
+    payload = multihost_utils.broadcast_one_to_all(payload)
+    _KEYSTORE.restore({"seed": int(payload[0]), "count": int(payload[1])})

@@ -1,0 +1,16 @@
+"""Main-process-only tqdm (parity: reference utils/tqdm.py)."""
+
+from __future__ import annotations
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError as e:  # pragma: no cover - tqdm is in the base image
+        raise ImportError("tqdm is required for accelerate_tpu.utils.tqdm") from e
+
+    if main_process_only:
+        from ..state import PartialState
+
+        kwargs["disable"] = kwargs.get("disable", False) or not PartialState().is_main_process
+    return _tqdm(*args, **kwargs)

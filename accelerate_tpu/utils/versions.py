@@ -1,0 +1,40 @@
+"""Version comparison helpers (parity: reference utils/versions.py)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+_OPS = {
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">=": operator.ge,
+    ">": operator.gt,
+}
+
+
+def _as_tuple(version: str) -> tuple[int, ...]:
+    parts = []
+    for chunk in version.split("+")[0].split(".")[:3]:
+        digits = "".join(ch for ch in chunk if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def compare_versions(library_or_version: str, op: str, requirement_version: str) -> bool:
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {list(_OPS)}, got {op}")
+    version = library_or_version
+    try:
+        version = importlib.metadata.version(library_or_version)
+    except importlib.metadata.PackageNotFoundError:
+        pass
+    return _OPS[op](_as_tuple(version), _as_tuple(requirement_version))
+
+
+def is_jax_version(op: str, version: str) -> bool:
+    import jax
+
+    return _OPS[op](_as_tuple(jax.__version__), _as_tuple(version))

@@ -1,0 +1,117 @@
+"""Tests for ops/operations.py (reference: test_utils/scripts/test_ops.py + test_utils.py)."""
+
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import ops
+from accelerate_tpu.state import PartialState
+
+Point = namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_honors_types():
+    data = {"a": [np.ones(2), (np.zeros(3), Point(np.ones(1), np.zeros(1)))], "b": "keep"}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert isinstance(out["a"][1][1], Point)
+    assert out["b"] == "keep"
+    np.testing.assert_array_equal(out["a"][0], np.full(2, 2.0))
+
+
+def test_send_to_device_default_sharding():
+    batch = {"input_ids": np.arange(32).reshape(8, 4), "mask": np.ones((8, 4))}
+    out = ops.send_to_device(batch)
+    assert isinstance(out["input_ids"], jax.Array)
+    assert len(out["input_ids"].sharding.device_set) == 8
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(4), "meta": np.zeros(2)}
+    out = ops.send_to_device(batch, skip_keys="meta")
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_gather_global_array():
+    state = PartialState()
+    x = jax.device_put(np.arange(16, dtype=np.float32).reshape(16, 1), state.data_sharding())
+    gathered = ops.gather(x)
+    np.testing.assert_array_equal(gathered, np.arange(16, dtype=np.float32).reshape(16, 1))
+
+
+def test_gather_numpy_single_process():
+    np.testing.assert_array_equal(ops.gather(np.ones(3)), np.ones(3))
+
+
+def test_reduce_and_broadcast_single_process():
+    x = {"v": np.full((2,), 3.0)}
+    np.testing.assert_array_equal(ops.reduce(x, "sum")["v"], np.full((2,), 3.0))
+    np.testing.assert_array_equal(ops.broadcast(x)["v"], np.full((2,), 3.0))
+
+
+def test_pad_input_tensors():
+    batch = {"x": np.arange(10).reshape(10, 1)}
+    out = ops.pad_input_tensors(batch, batch_size=10, num_processes=4)
+    assert out["x"].shape[0] == 12
+    assert out["x"][-1, 0] == 9  # repeats the last row
+
+
+def test_concatenate_trees():
+    trees = [{"x": np.ones((2, 3))}, {"x": np.zeros((4, 3))}]
+    out = ops.concatenate(trees)
+    assert out["x"].shape == (6, 3)
+
+
+def test_find_batch_size_and_device():
+    batch = {"labels": np.zeros(5), "nested": [np.zeros((5, 7))]}
+    assert ops.find_batch_size(batch) == 5
+    x = jax.device_put(np.ones(2), jax.devices()[1])
+    assert ops.find_device({"a": x}) == jax.devices()[1]
+
+
+def test_get_data_structure_roundtrip():
+    data = {"x": np.ones((3, 2), np.float32), "y": [np.zeros(4, np.int32)]}
+    structure = ops.get_data_structure(data)
+    rebuilt = ops.initialize_tensors(structure)
+    assert rebuilt["x"].shape == (3, 2)
+    assert rebuilt["y"][0].dtype == np.int32
+
+
+def test_convert_to_fp32():
+    data = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": np.ones(2, np.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == np.int32  # non-float untouched
+
+
+def _bf16_forward(x):
+    return x.astype(jnp.bfloat16)
+
+
+def test_convert_outputs_to_fp32_pickleable():
+    import pickle
+
+    fn = ops.convert_outputs_to_fp32(_bf16_forward)
+    restored = pickle.loads(pickle.dumps(fn))
+    assert restored(jnp.ones(2)).dtype == jnp.float32
+
+
+def test_listify():
+    assert ops.listify({"x": np.arange(3)}) == {"x": [0, 1, 2]}
+
+
+def test_gather_object_single():
+    assert ops.gather_object([1, 2]) == [1, 2]
+
+
+def test_broadcast_object_list_single():
+    objs = ["a", {"b": 1}]
+    assert ops.broadcast_object_list(objs) == ["a", {"b": 1}]
+
+
+def test_pad_across_processes_single_noop():
+    x = np.ones((3, 2))
+    np.testing.assert_array_equal(ops.pad_across_processes(x), x)

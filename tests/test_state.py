@@ -1,0 +1,101 @@
+"""Tests for the topology singletons (reference: tests/test_state_checkpointing etc.)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import DistributedType
+
+
+def test_virtual_mesh_has_8_devices():
+    assert jax.device_count() == 8
+
+
+def test_partial_state_borg():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_processes == 1
+    assert a.process_index == 0
+    assert a.is_main_process
+    assert a.num_devices == 8
+
+
+def test_default_mesh_is_pure_data_parallel():
+    state = PartialState()
+    assert state.mesh.shape["data"] == 8
+    assert state.mesh.shape["tensor"] == 1
+    assert state.distributed_type == DistributedType.DATA_PARALLEL
+
+
+def test_parallelism_config_axis_sizes():
+    cfg = ParallelismConfig(fsdp=2, tensor=2)
+    sizes = cfg.axis_sizes(8)
+    assert sizes["data"] == 2
+    assert sizes["fsdp"] == 2
+    assert sizes["tensor"] == 2
+    assert cfg.distributed_type == DistributedType.HYBRID
+
+
+def test_parallelism_config_invalid():
+    with pytest.raises(ValueError):
+        ParallelismConfig(tensor=3).axis_sizes(8)
+    with pytest.raises(ValueError):
+        ParallelismConfig(data=2, tensor=2).axis_sizes(8)
+
+
+def test_mesh_with_model_axes():
+    state = PartialState(parallelism=ParallelismConfig(tensor=4))
+    assert state.mesh.shape["tensor"] == 4
+    assert state.mesh.shape["data"] == 2
+    assert state.distributed_type == DistributedType.TENSOR_PARALLEL
+
+
+def test_conflicting_reinit_raises():
+    PartialState(parallelism=ParallelismConfig(tensor=2))
+    with pytest.raises(ValueError):
+        PartialState(parallelism=ParallelismConfig(tensor=4))
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes(list(range(10))) as piece:
+        assert piece == list(range(10))
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def fn(x):
+        calls.append(x)
+        return x
+
+    assert fn(3) == 3
+    assert calls == [3]
+
+
+def test_accelerator_state_shares_topology():
+    astate = AcceleratorState(mixed_precision="bf16")
+    assert astate.num_devices == 8
+    assert astate.mixed_precision == "bf16"
+    assert astate.precision_policy.compute_dtype == jax.numpy.bfloat16
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert gs.remainder == -1
+    assert not gs.end_of_dataloader
+
+
+def test_data_sharding_spec():
+    state = PartialState(parallelism=ParallelismConfig(fsdp=2))
+    sharding = state.data_sharding()
+    x = jax.device_put(np.zeros((16, 4), np.float32), sharding)
+    # batch axis split over data(4) x fsdp(2) = 8 ways
+    assert len(x.sharding.device_set) == 8
