@@ -9,7 +9,12 @@ gradient accumulation, checkpointing, big-model inference, and a launcher CLI.
 __version__ = "0.1.0"
 
 from .state import AcceleratorState, GradientState, PartialState
+from .accelerator import Accelerator, PreparedModel
+from .data_loader import prepare_data_loader, skip_first_batches
 from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .scheduler import AcceleratedScheduler
+from . import ops
 from .utils import (
     DistributedType,
     FullyShardedDataParallelPlugin,

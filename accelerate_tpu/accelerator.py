@@ -1,0 +1,768 @@
+"""The Accelerator façade.
+
+Parity: reference accelerator.py (class Accelerator:162) — prepare (1173),
+backward (2007), accumulate (1017), no_sync (902), clip_grad_norm_ (2131),
+gather/gather_for_metrics (2209/2241), save_state/load_state (2729/2894),
+autocast (3189), unwrap_model (2374), save_model (2590), set_trigger/
+check_trigger (2037/2063), free_memory (3027).
+
+The training-loop inversion (SURVEY §7 hard part #1): the reference lets the
+user's eager loop drive torch autograd; XLA wants the step as a traced
+function. The seam chosen here keeps the loop shape but makes the *loss a
+function*:
+
+    model, optimizer, loader, scheduler = accelerator.prepare(...)
+    for batch in loader:
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(loss_fn, batch)   # jit value_and_grad
+            accelerator.clip_grad_norm_(model, 1.0)
+            optimizer.step()                              # jit optax update
+            scheduler.step()
+            optimizer.zero_grad()
+
+Each piece is a cached jit-compiled function over sharded global arrays, so
+the eager Python between them costs microseconds. For peak throughput,
+``accelerator.compiled_step(loss_fn)`` fuses grad+clip+update (+ a lax.scan
+microbatch loop for accumulation) into one XLA program.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from functools import partial
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .data_loader import BaseDataLoader, prepare_data_loader, skip_first_batches
+from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .ops import operations as ops
+from .parallel.sharding import PartitionRules, infer_shardings, replicated, shard_tree
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    CompilationConfig,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    KwargsHandler,
+    LossScaleKwargs,
+    MixedPrecisionPolicy,
+    ModelParallelPlugin,
+    ParallelismConfig,
+    PrecisionType,
+    ProjectConfiguration,
+)
+from .utils.environment import parse_int_from_env
+from .utils.random import next_rng_key, set_seed
+
+logger = get_logger(__name__)
+
+
+class ParamBox:
+    """Shared mutable holder so model and optimizer see one params tree."""
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
+class PreparedModel:
+    """A model bound to sharded parameters.
+
+    Callable like the original module; parameters live as global sharded
+    arrays in a box shared with the optimizer. ``unwrap_model`` returns the
+    original module; ``model.params`` is the live tree.
+    """
+
+    def __init__(self, module: Any, box: ParamBox, params_shardings: Any, policy: MixedPrecisionPolicy):
+        self.module = module
+        self.box = box
+        self.params_shardings = params_shardings
+        self.policy = policy
+        self._jit_apply = None
+
+    @property
+    def params(self) -> Any:
+        return self.box.value
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        self.box.value = value
+
+    @property
+    def apply(self) -> Callable:
+        if hasattr(self.module, "apply"):
+            return self.module.apply
+        return self.module  # bare apply function
+
+    def __call__(self, *args, **kwargs):
+        if self._jit_apply is None:
+            policy = self.policy
+            apply = self.apply
+
+            def fwd(params, *a, **kw):
+                params = cast_floating(params, policy.compute_dtype)
+                out = apply(params, *a, **kw)
+                return cast_floating(out, policy.output_dtype)
+
+            self._jit_apply = jax.jit(fwd)
+        return self._jit_apply(self.box.value, *args, **kwargs)
+
+    def eval_shape(self, *args, **kwargs):
+        return jax.eval_shape(self.apply, self.box.value, *args, **kwargs)
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(_cast, tree)
+
+
+class Accelerator:
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: Optional[str] = None,
+        gradient_accumulation_steps: Optional[int] = None,
+        parallelism: Optional[ParallelismConfig] = None,
+        fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+        model_parallel_plugin: Optional[ModelParallelPlugin] = None,
+        compilation_config: Optional[CompilationConfig] = None,
+        gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        project_config: Optional[ProjectConfiguration] = None,
+        project_dir: Optional[str] = None,
+        even_batches: bool = True,
+        dispatch_batches: Optional[bool] = None,
+        step_scheduler_with_optimizer: bool = True,
+        log_with: Optional[list] = None,
+        kwargs_handlers: Optional[list[KwargsHandler]] = None,
+    ):
+        # -- plugin / parallelism resolution (reference accelerator.py:285-335)
+        if model_parallel_plugin is not None and parallelism is None:
+            parallelism = ParallelismConfig(
+                fsdp=(fsdp_plugin.fsdp_size or 1) if fsdp_plugin else 1,
+                tensor=model_parallel_plugin.tensor_size,
+                sequence=model_parallel_plugin.sequence_size,
+                pipeline=model_parallel_plugin.pipeline_size,
+                expert=model_parallel_plugin.expert_size,
+            )
+        elif fsdp_plugin is not None and parallelism is None:
+            n = jax.device_count()
+            size = fsdp_plugin.fsdp_size or n
+            parallelism = ParallelismConfig(fsdp=size)
+
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # -- kwargs handlers (reference accelerator.py:338-372)
+        self.loss_scale_kwargs: Optional[LossScaleKwargs] = None
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, LossScaleKwargs):
+                self.loss_scale_kwargs = handler
+
+        self.state = AcceleratorState(mixed_precision=mixed_precision, parallelism=parallelism)
+        self.fsdp_plugin = fsdp_plugin
+        self.model_parallel_plugin = model_parallel_plugin
+        self.compilation_config = compilation_config or CompilationConfig()
+
+        if self.state.mixed_precision == "fp16" and self.loss_scale_kwargs is None:
+            self.loss_scale_kwargs = LossScaleKwargs()
+
+        # -- gradient accumulation (env-overridable, set by the launcher)
+        if gradient_accumulation_plugin is None:
+            steps = gradient_accumulation_steps or parse_int_from_env(
+                "ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1
+            )
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
+        elif gradient_accumulation_steps is not None:
+            raise ValueError(
+                "Pass either gradient_accumulation_steps or gradient_accumulation_plugin, not both."
+            )
+        self.gradient_state = GradientState(gradient_accumulation_plugin)
+
+        self.device_placement = device_placement
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.dispatch_batches = dispatch_batches
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+
+        seed = parse_int_from_env("ACCELERATE_SEED")
+        if seed is not None:
+            set_seed(seed)
+
+        self.log_with = log_with
+        self._models: list[PreparedModel] = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list[BaseDataLoader] = []
+        self._custom_objects: list = []
+        self._grad_fns: dict[int, Callable] = {}
+        self._accum_step = 0
+        self.step = 0
+        self.trackers: list = []
+        self._save_model_hooks: list = []
+        self._load_model_hooks: list = []
+
+        self.flag_tensor = None
+
+    # ------------------------------------------------------------------
+    # topology passthrough (reference properties)
+    # ------------------------------------------------------------------
+
+    @property
+    def distributed_type(self):
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self) -> int:
+        return self.state.num_processes
+
+    @property
+    def process_index(self) -> int:
+        return self.state.process_index
+
+    @property
+    def local_process_index(self) -> int:
+        return self.state.local_process_index
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.state.is_last_process
+
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def mixed_precision(self) -> str:
+        return self.state.mixed_precision
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value: int) -> None:
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def sync_gradients(self) -> bool:
+        return self.gradient_state.sync_gradients
+
+    @property
+    def project_dir(self) -> Optional[str]:
+        return self.project_configuration.project_dir
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.state.use_distributed
+
+    def print(self, *args, **kwargs) -> None:
+        self.state.print(*args, **kwargs)
+
+    def wait_for_everyone(self) -> None:
+        self.state.wait_for_everyone()
+
+    @contextmanager
+    def main_process_first(self):
+        with self.state.main_process_first():
+            yield
+
+    @contextmanager
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        with self.state.split_between_processes(inputs, apply_padding=apply_padding) as piece:
+            yield piece
+
+    def on_main_process(self, fn):
+        return self.state.on_main_process(fn)
+
+    def on_last_process(self, fn):
+        return self.state.on_last_process(fn)
+
+    def on_process(self, fn=None, process_index: int = 0):
+        return self.state.on_process(fn, process_index=process_index)
+
+    # ------------------------------------------------------------------
+    # prepare
+    # ------------------------------------------------------------------
+
+    def _partition_rules(self, module: Any) -> PartitionRules:
+        rules: list[tuple[str, tuple]] = []
+        if self.model_parallel_plugin is not None and self.model_parallel_plugin.partition_rules:
+            rules.extend(self.model_parallel_plugin.partition_rules)
+        if hasattr(module, "partition_rules"):
+            rules.extend(module.partition_rules())
+        return PartitionRules(rules, fsdp_plugin=self.fsdp_plugin)
+
+    def prepare_model(self, model: Any, params: Any = None, device_placement: Optional[bool] = None) -> PreparedModel:
+        """Bind a model to sharded global parameters.
+
+        ``model`` is anything with ``.apply(params, ...)`` (our models, flax
+        linen modules) or a bare apply function; ``params`` may be given, or
+        the model must expose ``.init(rng)``.
+        """
+        if isinstance(model, PreparedModel):
+            return model
+        if params is None:
+            if hasattr(model, "init"):
+                params = model.init(next_rng_key())
+            else:
+                raise ValueError(
+                    "prepare_model needs parameters: pass params= or give the model an init(rng) method."
+                )
+        rules = self._partition_rules(model)
+        shardings = infer_shardings(params, self.mesh, rules)
+        if device_placement if device_placement is not None else self.device_placement:
+            params = shard_tree(params, shardings)
+        prepared = PreparedModel(model, ParamBox(params), shardings, self.state.precision_policy)
+        self._models.append(prepared)
+        return prepared
+
+    def prepare_optimizer(self, tx: Any, model: Optional[PreparedModel] = None) -> AcceleratedOptimizer:
+        if isinstance(tx, AcceleratedOptimizer):
+            return tx
+        if model is None:
+            if not self._models:
+                raise ValueError("Prepare (or pass) the model before its optimizer.")
+            model = self._models[-1]
+        optimizer = AcceleratedOptimizer(
+            tx,
+            model.box,
+            model.params_shardings,
+            scaler=self.loss_scale_kwargs if self.state.precision_policy.requires_loss_scaling else None,
+        )
+        self._optimizers.append(optimizer)
+        return optimizer
+
+    def prepare_scheduler(self, schedule_fn: Callable[[int], float]) -> AcceleratedScheduler:
+        if isinstance(schedule_fn, AcceleratedScheduler):
+            return schedule_fn
+        scheduler = AcceleratedScheduler(
+            schedule_fn,
+            optimizer=self._optimizers[-1] if self._optimizers else None,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.split_batches,
+        )
+        self._schedulers.append(scheduler)
+        return scheduler
+
+    def prepare_data_loader(self, loader: Any, device_placement: Optional[bool] = None) -> BaseDataLoader:
+        prepared = prepare_data_loader(
+            loader,
+            device_placement=device_placement if device_placement is not None else self.device_placement,
+            split_batches=self.split_batches,
+            even_batches=self.even_batches,
+            dispatch_batches=self.dispatch_batches,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def _is_model_like(self, obj: Any) -> bool:
+        return isinstance(obj, PreparedModel) or hasattr(obj, "apply") and not self._is_optimizer_like(obj)
+
+    @staticmethod
+    def _is_optimizer_like(obj: Any) -> bool:
+        # optax GradientTransformation is a NamedTuple of (init, update)
+        return hasattr(obj, "init") and hasattr(obj, "update") and not hasattr(obj, "apply")
+
+    @staticmethod
+    def _is_loader_like(obj: Any) -> bool:
+        return (
+            isinstance(obj, BaseDataLoader)
+            or hasattr(obj, "__getitem__")
+            and hasattr(obj, "__len__")
+            or hasattr(obj, "__iter__")
+            and not callable(obj)
+        )
+
+    def prepare(self, *args: Any, device_placement: Optional[list] = None) -> Any:
+        """Prepare objects in their natural order (reference accelerator.py:1173).
+
+        Dispatch by duck type: models (``.apply``/``.init``), optax
+        transformations (``.init``+``.update``), dataloaders/datasets
+        (iterable or indexable), schedule callables (int → float).
+        """
+        result = []
+        # pass 1: models (optimizers bind to the model prepared before them)
+        prepared_map: dict[int, Any] = {}
+        for i, obj in enumerate(args):
+            if isinstance(obj, PreparedModel) or (hasattr(obj, "apply") and hasattr(obj, "init") and not self._is_optimizer_like(obj)):
+                prepared_map[i] = self.prepare_model(obj)
+        for i, obj in enumerate(args):
+            if i in prepared_map:
+                continue
+            if self._is_optimizer_like(obj):
+                prepared_map[i] = self.prepare_optimizer(obj)
+            elif isinstance(obj, (BaseDataLoader,)) or self._is_loader_like(obj):
+                prepared_map[i] = self.prepare_data_loader(obj)
+            elif callable(obj):
+                prepared_map[i] = self.prepare_scheduler(obj)
+            else:
+                prepared_map[i] = obj
+        result = tuple(prepared_map[i] for i in range(len(args)))
+        return result if len(result) != 1 else result[0]
+
+    # ------------------------------------------------------------------
+    # the step: backward / clip / accumulate
+    # ------------------------------------------------------------------
+
+    def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel, has_aux: bool) -> Callable:
+        key = (id(loss_fn), id(model), has_aux)
+        if key not in self._grad_fns:
+            policy = self.state.precision_policy
+            remat_policy = self.compilation_config.checkpoint_policy()
+
+            def scaled_loss(params, batch, scale):
+                compute_params = cast_floating(params, policy.compute_dtype)
+                compute_batch = cast_floating(batch, policy.compute_dtype)
+                fn = loss_fn
+                if remat_policy is not None:
+                    fn = jax.checkpoint(fn, policy=remat_policy)
+                out = fn(compute_params, compute_batch)
+                if has_aux:
+                    loss, aux = out
+                    return (loss.astype(jnp.float32) * scale, aux)
+                return out.astype(jnp.float32) * scale
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=has_aux)
+
+            @partial(jax.jit, static_argnums=())
+            def run(params, batch, scale):
+                value, grads = grad_fn(params, batch, scale)
+                return value, grads
+
+            self._grad_fns[key] = run
+        return self._grad_fns[key]
+
+    def backward(self, loss_fn: Callable, batch: Any = None, model: Optional[PreparedModel] = None, has_aux: bool = False, **kwargs):
+        """Compute gradients of ``loss_fn(params, batch)`` and accumulate them.
+
+        Replaces ``loss.backward()`` (reference accelerator.py:2007): the loss
+        is passed as a *function* because XLA differentiates traced programs,
+        not materialized scalars. Loss is divided by the accumulation window
+        via the optimizer's mean (reference divides the loss, 2025-2027 — same
+        result, fewer casts). Returns the (unscaled) loss value; with
+        ``has_aux`` returns (loss, aux).
+        """
+        if model is None:
+            if not self._models:
+                raise ValueError("backward() needs a prepared model.")
+            model = self._models[-1]
+        # route grads to the optimizer bound to THIS model's params (multi-model
+        # setups like GANs prepare several pairs)
+        optimizer = next((opt for opt in self._optimizers if opt._box is model.box), None)
+        scale = (
+            optimizer.scale
+            if optimizer is not None and optimizer.scale is not None
+            else jnp.float32(1.0)
+        )
+        run = self._get_grad_fn(loss_fn, model, has_aux)
+        value, grads = run(model.params, batch, scale)
+        if optimizer is not None:
+            optimizer.accumulate_grads(grads)
+        else:
+            self._loose_grads = grads
+        if has_aux:
+            loss, aux = value
+            return loss / scale, aux
+        return value / scale
+
+    def clip_grad_norm_(self, model_or_max_norm=None, max_norm: Optional[float] = None, norm_type: int = 2):
+        """Register gradient clipping for the next optimizer step.
+
+        Signature accepts (parameters, max_norm) reference-style or just
+        (max_norm). Clipping happens inside the jitted update using the
+        *accumulated* gradient — identical semantics to clipping after
+        unscale (reference accelerator.py:2131-2180).
+        """
+        if norm_type != 2:
+            raise ValueError("Only the L2 grad norm is supported under XLA.")
+        if max_norm is None:
+            max_norm = model_or_max_norm
+        if max_norm is None:
+            raise ValueError("clip_grad_norm_ needs max_norm")
+        for optimizer in self._optimizers:
+            optimizer.set_clip_grad_norm(float(max_norm))
+
+    def clip_grad_value_(self, *args, **kwargs):
+        raise NotImplementedError(
+            "clip_grad_value_ is not implemented; use clip_grad_norm_ (value clipping "
+            "breaks gradient direction and is rarely what you want at scale)."
+        )
+
+    def _do_sync(self) -> None:
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self._accum_step = 0
+            self.gradient_state._set_sync_gradients(True)
+        else:
+            self._accum_step += 1
+            sync = (self._accum_step % self.gradient_state.num_steps == 0) or self.gradient_state.sync_each_batch
+            self.gradient_state._set_sync_gradients(sync)
+
+    @contextmanager
+    def accumulate(self, *models):  # noqa: ARG002 - models accepted for parity
+        """Gradient-accumulation window (reference accelerator.py:1017)."""
+        self._do_sync()
+        yield
+
+    @contextmanager
+    def no_sync(self, model=None):  # noqa: ARG002
+        """Force-accumulate context (reference accelerator.py:902). Under SPMD
+        there is no DDP hook to suppress; this just marks the step as
+        non-syncing so optimizer.step()/zero_grad() no-op."""
+        previous = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(previous)
+
+    @contextmanager
+    def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):  # noqa: ARG002
+        """Parity shim (reference accelerator.py:1053): even_batches padding in
+        the loaders already guarantees equal step counts, so there is nothing
+        to join; the context simply yields."""
+        yield
+
+    @contextmanager
+    def autocast(self, autocast_handler=None):  # noqa: ARG002
+        """Parity shim (reference accelerator.py:3189): the dtype policy is
+        applied functionally inside jitted functions, not via a context."""
+        yield
+
+    # ------------------------------------------------------------------
+    # fused fast path
+    # ------------------------------------------------------------------
+
+    def compiled_step(self, loss_fn: Callable, model: Optional[PreparedModel] = None, clip_grad_norm: Optional[float] = None):
+        """One fused jit program: grads (+ scan over microbatches) → clip → update.
+
+        Returns ``step(batch) -> loss``. The batch's leading dim is split into
+        ``gradient_accumulation_steps`` microbatches inside the program via
+        ``lax.scan`` — no eager Python between microbatches, buffers donated.
+        This is what the reference's whole hot loop (SURVEY §3.3) compiles down
+        to, and the path benchmarks should use.
+        """
+        import optax
+
+        if model is None:
+            model = self._models[-1]
+        optimizer = next((opt for opt in self._optimizers if opt._box is model.box), None)
+        if optimizer is None:
+            raise ValueError("compiled_step needs an optimizer prepared for this model.")
+        policy = self.state.precision_policy
+        num_micro = self.gradient_state.num_steps
+        tx = optimizer.tx
+        remat_policy = self.compilation_config.checkpoint_policy()
+
+        def loss_of(params, batch):
+            fn = loss_fn
+            if remat_policy is not None:
+                fn = jax.checkpoint(fn, policy=remat_policy)
+            return fn(cast_floating(params, policy.compute_dtype), cast_floating(batch, policy.compute_dtype))
+
+        def step_impl(params, opt_state, batch):
+            if num_micro > 1:
+                def micro(carry, mb):
+                    grads_acc, loss_acc = carry
+                    loss, grads = jax.value_and_grad(loss_of)(params, mb)
+                    return (jax.tree.map(jnp.add, grads_acc, grads), loss_acc + loss), None
+
+                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                micro_batches = jax.tree.map(
+                    lambda x: x.reshape((num_micro, x.shape[0] // num_micro) + x.shape[1:]), batch
+                )
+                (grads, loss), _ = jax.lax.scan(micro, (zeros, jnp.float32(0.0)), micro_batches)
+                grads = jax.tree.map(lambda g: g / num_micro, grads)
+                loss = loss / num_micro
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(params, batch)
+            if clip_grad_norm is not None:
+                gnorm = optax.global_norm(grads)
+                factor = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        jitted = jax.jit(step_impl, donate_argnums=(0, 1))
+
+        def step(batch):
+            params, opt_state, loss = jitted(model.params, optimizer.opt_state, batch)
+            model.params = params
+            optimizer.opt_state = opt_state
+            optimizer._step_count += 1
+            return loss
+
+        return step
+
+    # ------------------------------------------------------------------
+    # gather / metrics
+    # ------------------------------------------------------------------
+
+    def gather(self, tensor):
+        return ops.gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather + drop the duplicate samples the even-batch padding added on
+        the final batch (reference accelerator.py:2241-2301)."""
+        if use_gather_object:
+            data = ops.gather_object(input_data)
+        else:
+            data = ops.gather(input_data)
+        try:
+            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+                def _truncate(t):
+                    return t[: self.gradient_state.remainder]
+
+                data = ops.recursively_apply(_truncate, data)
+        except Exception:
+            pass
+        return data
+
+    def reduce(self, tensor, reduction: str = "mean", scale: float = 1.0):
+        return ops.reduce(tensor, reduction=reduction, scale=scale)
+
+    def pad_across_processes(self, tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+        return ops.pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
+
+    # ------------------------------------------------------------------
+    # trigger primitive (coordinated breakpoints, reference 2037-2094)
+    # ------------------------------------------------------------------
+
+    def set_trigger(self) -> None:
+        self.flag_tensor = np.ones((), dtype=np.int32)
+
+    def check_trigger(self) -> bool:
+        flag = self.flag_tensor if self.flag_tensor is not None else np.zeros((), dtype=np.int32)
+        total = ops.reduce(flag, reduction="sum")
+        if float(total) >= 1:
+            self.flag_tensor = None
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # model/unwrap/save
+    # ------------------------------------------------------------------
+
+    def unwrap_model(self, model: PreparedModel, keep_fp32_wrapper: bool = True):  # noqa: ARG002
+        return model.module if isinstance(model, PreparedModel) else model
+
+    def get_state_dict(self, model: PreparedModel, unwrap: bool = True):  # noqa: ARG002
+        """Full (host-replicated numpy) state dict — the ZeRO-3 consolidation
+        analogue (reference accelerator.py:3096)."""
+        return ops.to_numpy(model.params)
+
+    def save_model(self, model: PreparedModel, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
+        from .checkpointing import save_model_weights
+
+        save_model_weights(
+            model.params, save_directory, max_shard_size=max_shard_size, safe_serialization=safe_serialization
+        )
+
+    def register_for_checkpointing(self, *objects) -> None:
+        invalid = [o for o in objects if not (hasattr(o, "state_dict") and hasattr(o, "load_state_dict"))]
+        if invalid:
+            raise ValueError(
+                f"All objects must have state_dict/load_state_dict methods; got invalid: {invalid}"
+            )
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook: Callable):
+        self._save_model_hooks.append(hook)
+        return _RemovableHandle(self._save_model_hooks, hook)
+
+    def register_load_state_pre_hook(self, hook: Callable):
+        self._load_model_hooks.append(hook)
+        return _RemovableHandle(self._load_model_hooks, hook)
+
+    def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, **save_model_kwargs)
+
+    def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, **load_model_kwargs)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return skip_first_batches(dataloader, num_batches)
+
+    def free_memory(self, *objects):
+        """Release prepared-object references (reference accelerator.py:3027)."""
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self._grad_fns.clear()
+        self._accum_step = 0
+        import gc
+
+        gc.collect()
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    # ------------------------------------------------------------------
+    # tracking (full implementation in tracking.py)
+    # ------------------------------------------------------------------
+
+    def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: Optional[dict] = None):
+        from .tracking import filter_trackers
+
+        self.trackers = filter_trackers(
+            self.log_with, self.project_configuration.logging_dir, project_name, config, init_kwargs
+        )
+
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def end_training(self) -> None:
+        for tracker in self.trackers:
+            tracker.finish()
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"{name} is not an active tracker")
+
+    def __deepcopy__(self, memo):
+        # An Accelerator wraps process-global singletons; copying must not
+        # fork them (reference accelerator.py:3268).
+        return self
+
+
+class _RemovableHandle:
+    def __init__(self, hook_list: list, hook):
+        self._list = hook_list
+        self._hook = hook
+
+    def remove(self) -> None:
+        if self._hook in self._list:
+            self._list.remove(self._hook)

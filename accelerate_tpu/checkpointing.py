@@ -1,0 +1,306 @@
+"""Checkpoint save/load for the whole training state.
+
+Parity: reference checkpointing.py (save_accelerator_state:51,
+load_accelerator_state:153, custom objects:259) + Accelerator.save_state
+rotation logic (accelerator.py:2767-2861).
+
+Format (directory):
+    model_<i>.safetensors          flattened "a/b/c" path → tensor (interop-friendly)
+    optimizer_<i>.npz              opt-state leaves by index + metadata json inside
+    scheduler_<i>.json
+    scaler_<i>.json                dynamic loss-scale state (fp16 only)
+    random_states_<p>.pkl          python/numpy/jax-keystore RNG snapshot per host
+    custom_checkpoint_<i>.pkl
+
+RNG state is tiny because jax PRNG keys are values derived from (seed, count)
+— the whole per-device generator-state zoo of the reference (checkpointing.py:
+136-149) collapses to two integers plus the host RNGs.
+
+Model/optimizer arrays are gathered to host and written by process 0 (every
+array also lands back on its NamedSharding at load, so resuming on a different
+topology works). TODO(perf): per-host shard writing for >10B models.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .logging import get_logger
+from .ops.operations import to_numpy
+from .parallel.sharding import param_path
+from .state import PartialState
+from .utils.constants import CHECKPOINT_DIR_PREFIX
+from .utils.random import restore_rng_state, rng_state
+
+logger = get_logger(__name__)
+
+MODEL_FILE = "model_{i}.safetensors"
+OPTIMIZER_FILE = "optimizer_{i}.npz"
+SCHEDULER_FILE = "scheduler_{i}.json"
+SCALER_FILE = "scaler_{i}.json"
+RNG_FILE = "random_states_{p}.pkl"
+CUSTOM_FILE = "custom_checkpoint_{i}.pkl"
+
+
+def flatten_params(params: Any) -> dict[str, np.ndarray]:
+    """Pytree → {"path/to/leaf": host numpy} (gathers sharded arrays)."""
+    flat = {}
+
+    def _visit(key_path, leaf):
+        flat[param_path(key_path)] = np.asarray(to_numpy(leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(_visit, params)
+    return flat
+
+
+def unflatten_into(params: Any, flat: dict[str, np.ndarray], shardings: Any = None) -> Any:
+    """Place ``flat`` values into the structure of ``params`` (and shardings)."""
+
+    def _pick(key_path, leaf, sharding=None):
+        path = param_path(key_path)
+        if path not in flat:
+            raise KeyError(f"checkpoint missing parameter {path!r}")
+        value = np.asarray(flat[path])
+        if value.shape != tuple(leaf.shape):
+            raise ValueError(f"shape mismatch for {path}: checkpoint {value.shape} vs model {tuple(leaf.shape)}")
+        value = value.astype(leaf.dtype)
+        if sharding is not None:
+            return jax.device_put(value, sharding)
+        return jnp.asarray(value)
+
+    if shardings is not None:
+        return jax.tree_util.tree_map_with_path(_pick, params, shardings)
+    return jax.tree_util.tree_map_with_path(lambda kp, leaf: _pick(kp, leaf), params)
+
+
+# ---------------------------------------------------------------------------
+# model weights (sharded files + index, reference utils/modeling.py:206)
+# ---------------------------------------------------------------------------
+
+
+def _parse_size(size: str | int) -> int:
+    if isinstance(size, int):
+        return size
+    match = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([KMGT]?B)", size.strip(), re.IGNORECASE)
+    if not match:
+        raise ValueError(f"Cannot parse size {size!r}")
+    mult = {"B": 1, "KB": 2**10, "MB": 2**20, "GB": 2**30, "TB": 2**40}[match.group(2).upper()]
+    return int(float(match.group(1)) * mult)
+
+
+def _save_flat(flat: dict[str, np.ndarray], path: str, safe_serialization: bool = True) -> None:
+    if safe_serialization:
+        try:
+            from safetensors.numpy import save_file
+
+            # safetensors rejects bf16 numpy via ml_dtypes? it supports bfloat16.
+            save_file(flat, path)
+            return
+        except ImportError:
+            pass
+    np.savez(path.replace(".safetensors", ".npz"), **flat)
+
+
+def _load_flat(path: str) -> dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_model_weights(
+    params: Any,
+    save_directory: str,
+    max_shard_size: str | int = "10GB",
+    safe_serialization: bool = True,
+    weights_name: str = "model.safetensors",
+) -> None:
+    """Write model weights, sharding files over ``max_shard_size`` with an
+    index.json (reference shard_checkpoint utils/modeling.py:206 + save 2590)."""
+    state = PartialState()
+    flat = flatten_params(params)
+    if not state.is_main_process:
+        state.wait_for_everyone()
+        return
+    os.makedirs(save_directory, exist_ok=True)
+    limit = _parse_size(max_shard_size)
+
+    shards: list[dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for key, value in flat.items():
+        nbytes = value.nbytes
+        if sizes[-1] + nbytes > limit and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][key] = value
+        sizes[-1] += nbytes
+
+    if len(shards) == 1:
+        _save_flat(shards[0], os.path.join(save_directory, weights_name), safe_serialization)
+    else:
+        base, ext = os.path.splitext(weights_name)
+        weight_map = {}
+        for i, shard in enumerate(shards):
+            shard_name = f"{base}-{i + 1:05d}-of-{len(shards):05d}{ext}"
+            _save_flat(shard, os.path.join(save_directory, shard_name), safe_serialization)
+            for key in shard:
+                weight_map[key] = shard_name
+        index = {"metadata": {"total_size": sum(sizes)}, "weight_map": weight_map}
+        with open(os.path.join(save_directory, f"{weights_name}.index.json"), "w") as f:
+            json.dump(index, f, indent=2)
+    state.wait_for_everyone()
+
+
+def load_model_weights(path: str) -> dict[str, np.ndarray]:
+    """Load a flat weight dict from a file, a shard-index, or a directory."""
+    if os.path.isdir(path):
+        for candidate in ("model.safetensors", "model.safetensors.index.json", "model.npz"):
+            full = os.path.join(path, candidate)
+            if os.path.exists(full):
+                path = full
+                break
+        else:
+            raise FileNotFoundError(f"No model weights found under {path}")
+    if path.endswith(".index.json"):
+        with open(path) as f:
+            index = json.load(f)
+        directory = os.path.dirname(path)
+        flat: dict[str, np.ndarray] = {}
+        for shard_name in sorted(set(index["weight_map"].values())):
+            flat.update(_load_flat(os.path.join(directory, shard_name)))
+        return flat
+    return _load_flat(path)
+
+
+# ---------------------------------------------------------------------------
+# full accelerator state
+# ---------------------------------------------------------------------------
+
+
+def _resolve_save_dir(accelerator, output_dir: Optional[str]) -> str:
+    project = accelerator.project_configuration
+    if project.automatic_checkpoint_naming:
+        base = os.path.join(project.project_dir or output_dir or ".", "checkpoints")
+        os.makedirs(base, exist_ok=True)
+        existing = _list_checkpoints(base)
+        if project.total_limit is not None and len(existing) + 1 > project.total_limit:
+            for stale in existing[: len(existing) + 1 - project.total_limit]:
+                logger.info(f"Deleting {stale} to respect total_limit={project.total_limit}")
+                shutil.rmtree(stale, ignore_errors=True)
+        target = os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{project.iteration}")
+        if os.path.exists(target):
+            raise ValueError(f"Checkpoint directory {target} already exists — bump project_configuration.iteration.")
+        return target
+    if output_dir is None:
+        raise ValueError("save_state needs output_dir (or automatic_checkpoint_naming).")
+    return output_dir
+
+
+def _list_checkpoints(base: str) -> list[str]:
+    entries = []
+    for name in os.listdir(base):
+        match = re.fullmatch(rf"{CHECKPOINT_DIR_PREFIX}_(\d+)", name)
+        if match:
+            entries.append((int(match.group(1)), os.path.join(base, name)))
+    return [path for _, path in sorted(entries)]
+
+
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+    state = PartialState()
+    output_dir = _resolve_save_dir(accelerator, output_dir)
+    os.makedirs(output_dir, exist_ok=True)
+    logger.info(f"Saving current state to {output_dir}")
+
+    for hook in accelerator._save_model_hooks:
+        hook(accelerator._models, [], output_dir)
+
+    for i, model in enumerate(accelerator._models):
+        save_model_weights(
+            model.params, output_dir, safe_serialization=safe_serialization, weights_name=MODEL_FILE.format(i=i)
+        )
+    for i, optimizer in enumerate(accelerator._optimizers):
+        # to_numpy on sharded state is a collective — every host must run it;
+        # only the main process writes the result.
+        sd = optimizer.state_dict()
+        leaves = jax.tree.leaves(sd["opt_state"])
+        arrays = {f"leaf_{j}": np.asarray(to_numpy(leaf)) for j, leaf in enumerate(leaves)}
+        if state.is_main_process:
+            meta = {"step_count": sd["step_count"]}
+            if "scale" in sd:
+                meta["scale"] = float(sd["scale"])
+                meta["growth_tracker"] = int(sd["growth_tracker"])
+            arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+            np.savez(os.path.join(output_dir, OPTIMIZER_FILE.format(i=i)), **arrays)
+    if state.is_main_process:
+        for i, scheduler in enumerate(accelerator._schedulers):
+            with open(os.path.join(output_dir, SCHEDULER_FILE.format(i=i)), "w") as f:
+                json.dump(scheduler.state_dict(), f)
+        for i, obj in enumerate(accelerator._custom_objects):
+            with open(os.path.join(output_dir, CUSTOM_FILE.format(i=i)), "wb") as f:
+                pickle.dump(obj.state_dict(), f)
+    # every host writes its own RNG snapshot (reference: random_states_{rank})
+    with open(os.path.join(output_dir, RNG_FILE.format(p=state.process_index)), "wb") as f:
+        pickle.dump(rng_state(), f)
+    state.wait_for_everyone()
+    if accelerator.project_configuration.automatic_checkpoint_naming:
+        accelerator.project_configuration.iteration += 1
+    return output_dir
+
+
+def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None) -> None:  # noqa: ARG001
+    state = PartialState()
+    project = accelerator.project_configuration
+    if input_dir is None:
+        if not project.automatic_checkpoint_naming:
+            raise ValueError("load_state needs input_dir (or automatic_checkpoint_naming).")
+        base = os.path.join(project.project_dir or ".", "checkpoints")
+        checkpoints = _list_checkpoints(base)
+        if not checkpoints:
+            raise FileNotFoundError(f"No checkpoints under {base}")
+        input_dir = checkpoints[-1]
+    logger.info(f"Loading states from {input_dir}")
+
+    for hook in accelerator._load_model_hooks:
+        hook(accelerator._models, input_dir)
+
+    for i, model in enumerate(accelerator._models):
+        weights_name = MODEL_FILE.format(i=i)
+        index = os.path.join(input_dir, f"{weights_name}.index.json")
+        source = index if os.path.exists(index) else os.path.join(input_dir, weights_name)
+        flat = load_model_weights(source)
+        model.params = unflatten_into(model.params, flat, model.params_shardings)
+    for i, optimizer in enumerate(accelerator._optimizers):
+        path = os.path.join(input_dir, OPTIMIZER_FILE.format(i=i))
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            leaves = [z[f"leaf_{j}"] for j in range(len(z.files) - 1)]
+        treedef = jax.tree.structure(optimizer.opt_state)
+        sd = {"opt_state": jax.tree.unflatten(treedef, leaves), "step_count": meta["step_count"]}
+        if "scale" in meta:
+            sd["scale"] = meta["scale"]
+            sd["growth_tracker"] = meta["growth_tracker"]
+        optimizer.load_state_dict(sd)
+    for i, scheduler in enumerate(accelerator._schedulers):
+        with open(os.path.join(input_dir, SCHEDULER_FILE.format(i=i))) as f:
+            scheduler.load_state_dict(json.load(f))
+    for i, obj in enumerate(accelerator._custom_objects):
+        with open(os.path.join(input_dir, CUSTOM_FILE.format(i=i)), "rb") as f:
+            obj.load_state_dict(pickle.load(f))
+    rng_path = os.path.join(input_dir, RNG_FILE.format(p=state.process_index))
+    if os.path.exists(rng_path):
+        with open(rng_path, "rb") as f:
+            restore_rng_state(pickle.load(f))
+    state.wait_for_everyone()

@@ -1,0 +1,713 @@
+"""Sharded data loading.
+
+Parity: reference data_loader.py — prepare_data_loader (745), BatchSamplerShard
+(100), IterableDatasetShard (256), DataLoaderShard (391), DataLoaderDispatcher
+(548), SeedableRandomSampler (67), skip_first_batches (1026),
+DataLoaderStateMixin (355).
+
+Design shift: the reference hands each rank a *local* per-rank batch; under
+SPMD the training step consumes one *global* array whose leading dim is
+sharded over the data-like mesh axes. So every loader here:
+
+1. computes this process's index shard with the same arithmetic the reference
+   uses (BatchSamplerShard / IterableDatasetShard behavior tables),
+2. collates the host-local rows to numpy,
+3. assembles a global ``jax.Array`` via
+   ``jax.make_array_from_process_local_data`` (multi-host) or a sharded
+   ``device_put`` (single host).
+
+The result: user code iterates batches exactly like the reference, but what
+comes out is already laid out for the jit-compiled step — no H2D copies inside
+the step, no per-rank choreography.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+import jax
+
+from .logging import get_logger
+from .state import GradientState, PartialState
+from .ops.operations import broadcast_object_list, concatenate, find_batch_size, recursively_apply
+
+logger = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# samplers
+# ---------------------------------------------------------------------------
+
+
+class SeedableRandomSampler:
+    """Shuffling whose permutation depends only on (seed, epoch).
+
+    Parity: reference data_loader.py:67-97 — every process derives the same
+    order, so index-sharding stays consistent without broadcasting RNG state.
+    """
+
+    def __init__(self, data_source_len: int, seed: int = 42, generator: Optional[np.random.Generator] = None):
+        self.data_source_len = data_source_len
+        self.initial_seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.data_source_len
+
+    def __iter__(self) -> Iterator[int]:
+        rng = np.random.default_rng(self.initial_seed + self.epoch)
+        yield from rng.permutation(self.data_source_len).tolist()
+
+
+class SequentialSampler:
+    def __init__(self, data_source_len: int):
+        self.data_source_len = data_source_len
+
+    def set_epoch(self, epoch: int) -> None:  # noqa: ARG002 - API parity
+        pass
+
+    def __len__(self) -> int:
+        return self.data_source_len
+
+    def __iter__(self) -> Iterator[int]:
+        yield from range(self.data_source_len)
+
+
+class BatchSampler:
+    """Groups sampler indices into batches (torch BatchSampler semantics)."""
+
+    def __init__(self, sampler, batch_size: int, drop_last: bool = False):
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return len(self.sampler) // self.batch_size
+        return math.ceil(len(self.sampler) / self.batch_size)
+
+    def __iter__(self) -> Iterator[list[int]]:
+        batch: list[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+
+class BatchSamplerShard:
+    """This process's share of a batch sampler (reference data_loader.py:100-253).
+
+    Two modes:
+    - ``split_batches=True``: each process takes its slice of *every* batch
+      (global batch size == sampler's batch size).
+    - ``split_batches=False``: processes take whole batches round-robin
+      (global batch size == sampler's batch size * num_processes).
+
+    ``even_batches=True`` pads by cycling indices from the start so every
+    process sees the same number of equally-sized batches.
+    """
+
+    def __init__(
+        self,
+        batch_sampler,
+        num_processes: int,
+        process_index: int,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        if split_batches and getattr(batch_sampler, "batch_size", None) is not None:
+            if batch_sampler.batch_size % num_processes != 0:
+                raise ValueError(
+                    f"split_batches=True requires the batch size ({batch_sampler.batch_size}) "
+                    f"to be a round multiple of num_processes ({num_processes})."
+                )
+        self.batch_sampler = batch_sampler
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        if self.split_batches:
+            return len(self.batch_sampler)
+        length = len(self.batch_sampler)
+        if self.drop_last:
+            # the trailing incomplete window is dropped regardless of even_batches
+            return length // self.num_processes
+        if length % self.num_processes == 0:
+            return length // self.num_processes
+        return length // self.num_processes + 1
+
+    def __iter__(self) -> Iterator[list[int]]:
+        if self.split_batches:
+            yield from self._iter_split()
+        else:
+            yield from self._iter_round_robin()
+
+    def _iter_split(self) -> Iterator[list[int]]:
+        full_size = self.batch_size
+        for batch in self.batch_sampler:
+            if full_size is not None and len(batch) < full_size:
+                # final short batch
+                if self.drop_last:
+                    continue
+                if self.even_batches:
+                    # pad to full size by cycling the batch (duplicates land at
+                    # the tail, so gather_for_metrics' remainder truncation works)
+                    batch = (batch * (full_size // len(batch) + 1))[:full_size]
+            share = len(batch) // self.num_processes
+            if share == 0:
+                continue
+            yield batch[self.process_index * share : (self.process_index + 1) * share]
+
+    def _iter_round_robin(self) -> Iterator[list[int]]:
+        initial_batches: list[list[int]] = []  # for even_batches cycling
+        cursor = 0
+        pending: list[list[int]] = []
+        for batch in self.batch_sampler:
+            if len(initial_batches) < self.num_processes:
+                initial_batches.append(batch)
+            pending.append(batch)
+            if len(pending) == self.num_processes:
+                if len(pending[self.process_index]) == (self.batch_size or len(pending[self.process_index])):
+                    yield pending[self.process_index]
+                else:
+                    # short final batch landed on us
+                    yield self._maybe_pad(pending[self.process_index])
+                pending = []
+                cursor += 1
+        if pending:
+            if self.drop_last:
+                return
+            if self.even_batches:
+                # recycle indices from the first batches to fill the window
+                all_idx = [i for b in pending for i in b]
+                fill = [i for b in initial_batches for i in b]
+                target = (self.batch_size or len(initial_batches[0])) * self.num_processes
+                while len(all_idx) < target and fill:
+                    all_idx.extend(fill[: target - len(all_idx)])
+                per = target // self.num_processes
+                piece = all_idx[self.process_index * per : (self.process_index + 1) * per]
+                if piece:
+                    yield piece
+            elif self.process_index < len(pending):
+                yield pending[self.process_index]
+
+    def _maybe_pad(self, batch: list[int]) -> list[int]:
+        if not self.even_batches or self.batch_size is None or len(batch) == self.batch_size:
+            return batch
+        cycled = (batch * (self.batch_size // len(batch) + 1))[: self.batch_size]
+        return cycled
+
+
+class IterableDatasetShard:
+    """Shard an un-indexable iterable across processes (data_loader.py:256-352).
+
+    Buffers ``batch_size * num_processes`` elements and yields this process's
+    slice; a final partial buffer is padded from the first buffer when
+    ``even_batches``.
+    """
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int,
+        num_processes: int,
+        process_index: int,
+        drop_last: bool = False,
+        split_batches: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.drop_last = drop_last
+        self.split_batches = split_batches
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __iter__(self):
+        real_batch_size = self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        share = real_batch_size // self.num_processes
+        process_slice = range(self.process_index * share, (self.process_index + 1) * share)
+
+        first_buffer = None
+        buffer = []
+        for element in self.dataset:
+            buffer.append(element)
+            if len(buffer) == real_batch_size:
+                if first_buffer is None:
+                    first_buffer = buffer.copy()
+                for i in process_slice:
+                    yield buffer[i]
+                buffer = []
+        if len(buffer) > 0 and not self.drop_last:
+            if first_buffer is None:
+                first_buffer = buffer.copy()
+            while len(buffer) < real_batch_size:
+                buffer += first_buffer[: real_batch_size - len(buffer)]
+            for i in process_slice:
+                yield buffer[i]
+
+
+# ---------------------------------------------------------------------------
+# collation
+# ---------------------------------------------------------------------------
+
+
+def default_collate(rows: list) -> Any:
+    """Stack a list of samples into a batch tree of numpy arrays."""
+    first = rows[0]
+    if isinstance(first, dict):
+        return {k: default_collate([r[k] for r in rows]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([r[i] for r in rows]) for i in range(len(first)))
+    arr = np.asarray(rows)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# loaders
+# ---------------------------------------------------------------------------
+
+
+class DataLoaderStateMixin:
+    """GradientState begin/end bookkeeping (reference data_loader.py:355-388)."""
+
+    def begin(self):
+        self.reset()
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        self.gradient_state._remove_dataloader(self)
+
+    def reset(self):
+        self.end_of_dataloader = False
+        self.remainder = -1
+
+
+class BaseDataLoader(DataLoaderStateMixin):
+    """Common machinery: one-batch lookahead (to flag end-of-epoch *before* the
+    last batch is consumed — reference data_loader.py:450-471) and global-array
+    assembly."""
+
+    def __init__(self, device_placement: bool = True, non_blocking: bool = False):
+        self.device_placement = device_placement
+        self.non_blocking = non_blocking
+        self.gradient_state = GradientState()
+        self.state = PartialState()
+        self.reset()
+
+    def _globalize(self, local_batch):
+        """Host-local numpy batch → global sharded jax.Array tree."""
+        if not self.device_placement:
+            return local_batch
+        sharding = self.state.data_sharding()
+
+        def _make(arr):
+            arr = np.asarray(arr)
+            if self.state.num_processes > 1:
+                return jax.make_array_from_process_local_data(sharding, arr)
+            target = sharding
+            split = sharding.mesh.shape["data"] * sharding.mesh.shape.get("fsdp", 1)
+            if arr.ndim == 0 or arr.shape[0] % split != 0:
+                target = jax.sharding.NamedSharding(sharding.mesh, jax.sharding.PartitionSpec())
+            return jax.device_put(arr, target)
+
+        return recursively_apply(_make, local_batch)
+
+    def _iterate_with_lookahead(self, batches: Iterator):
+        self.begin()
+        try:
+            current = None
+            have_current = False
+            batch_index = 0
+            for nxt in batches:
+                if have_current:
+                    yield self._globalize(current)
+                    batch_index += 1
+                current = nxt
+                have_current = True
+            if have_current:
+                self.end_of_dataloader = True
+                if getattr(self, "_total_samples", None) is not None:
+                    self.remainder = self._total_samples % self.total_batch_size or -1
+                yield self._globalize(current)
+        finally:
+            self.end()
+
+
+class DataLoaderShard(BaseDataLoader):
+    """Map-style dataset loader: index shard → collate → global array.
+
+    Parity: reference DataLoaderShard (data_loader.py:391-501).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_sampler,
+        collate_fn: Optional[Callable] = None,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        **kwargs,
+    ):
+        super().__init__(device_placement=device_placement)
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate_fn = collate_fn or default_collate
+        self.split_batches = split_batches
+        self.epoch = 0
+        try:
+            self._total_samples = len(dataset)
+        except TypeError:
+            self._total_samples = None
+
+    @property
+    def total_batch_size(self) -> int:
+        """Global batch size across all processes (reference data_loader.py:487).
+
+        Attribute-based (not isinstance) so wrappers like SkipBatchSampler,
+        which forward num_processes/split_batches, keep the arithmetic right.
+        """
+        bs = self.batch_sampler.batch_size or 1
+        if not getattr(self.batch_sampler, "split_batches", False):
+            return bs * getattr(self.batch_sampler, "num_processes", 1)
+        return bs
+
+    @property
+    def total_dataset_length(self) -> int:
+        return self._total_samples if self._total_samples is not None else -1
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.batch_sampler)
+
+    def _local_batches(self):
+        for index_batch in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in index_batch])
+
+    def __iter__(self):
+        yield from self._iterate_with_lookahead(self._local_batches())
+
+
+class IterableDataLoaderShard(BaseDataLoader):
+    """Loader over an IterableDatasetShard (no indices)."""
+
+    def __init__(
+        self,
+        dataset_shard: IterableDatasetShard,
+        collate_fn: Optional[Callable] = None,
+        device_placement: bool = True,
+    ):
+        super().__init__(device_placement=device_placement)
+        self.dataset = dataset_shard
+        self.collate_fn = collate_fn or default_collate
+        self._total_samples = None
+
+    @property
+    def total_batch_size(self) -> int:
+        ds = self.dataset
+        return ds.batch_size if ds.split_batches else ds.batch_size * ds.num_processes
+
+    def set_epoch(self, epoch: int) -> None:
+        self.dataset.set_epoch(epoch)
+
+    def _local_batches(self):
+        share = self.total_batch_size // self.dataset.num_processes
+        rows = []
+        for row in self.dataset:
+            rows.append(row)
+            if len(rows) == share:
+                yield self.collate_fn(rows)
+                rows = []
+        if rows:
+            yield self.collate_fn(rows)
+
+    def __iter__(self):
+        yield from self._iterate_with_lookahead(self._local_batches())
+
+
+class DataLoaderDispatcher(BaseDataLoader):
+    """Process 0 reads the full loader and scatters slices.
+
+    Parity: reference DataLoaderDispatcher (data_loader.py:548-742). Needed
+    when the dataset is only readable on one host (e.g. a stream). Host 0
+    iterates, broadcasts the batch structure + data; every host slices its
+    share and the batch is assembled into a global array.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        collate_fn: Optional[Callable] = None,
+        device_placement: bool = True,
+        drop_last: bool = False,
+    ):
+        super().__init__(device_placement=device_placement)
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self._total_samples = None
+
+    @property
+    def total_batch_size(self) -> int:
+        return self.batch_size * self.state.num_processes
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def _local_batches(self):
+        state = self.state
+        target = self.total_batch_size
+        if state.is_main_process:
+            rows: list = []
+            iterator = iter(self.dataset)
+            first_full: list | None = None
+            while True:
+                try:
+                    while len(rows) < target:
+                        rows.append(next(iterator))
+                except StopIteration:
+                    if not rows:
+                        broadcast_object_list([None]) if state.num_processes > 1 else None
+                        return
+                    if self.drop_last:
+                        if state.num_processes > 1:
+                            broadcast_object_list([None])
+                        return
+                    if first_full is not None:
+                        rows += first_full[: target - len(rows)]
+                    else:
+                        while len(rows) < target:
+                            rows += rows[: target - len(rows)]
+                    yield self._scatter(rows)
+                    if state.num_processes > 1:
+                        broadcast_object_list([None])
+                    return
+                if first_full is None:
+                    first_full = rows.copy()
+                yield self._scatter(rows)
+                rows = []
+        else:
+            while True:
+                batch = self._scatter(None)
+                if batch is None:
+                    return
+                yield batch
+
+    def _scatter(self, rows):
+        state = self.state
+        if state.num_processes == 1:
+            return self.collate_fn(rows)
+        payload = [rows] if state.is_main_process else [None]
+        broadcast_object_list(payload)
+        rows = payload[0]
+        if rows is None:
+            return None
+        share = len(rows) // state.num_processes
+        mine = rows[state.process_index * share : (state.process_index + 1) * share]
+        return self.collate_fn(mine)
+
+    def __iter__(self):
+        yield from self._iterate_with_lookahead(self._local_batches())
+
+
+# ---------------------------------------------------------------------------
+# factory
+# ---------------------------------------------------------------------------
+
+
+def prepare_data_loader(
+    dataloader_or_dataset,
+    device_placement: bool = True,
+    split_batches: bool = False,
+    batch_size: Optional[int] = None,
+    shuffle: Optional[bool] = None,
+    seed: Optional[int] = None,
+    collate_fn: Optional[Callable] = None,
+    drop_last: Optional[bool] = None,
+    even_batches: bool = True,
+    dispatch_batches: Optional[bool] = None,
+    use_seedable_sampler: bool = True,
+) -> BaseDataLoader:
+    """Decide the sharding strategy and build the loader (data_loader.py:745-978).
+
+    Accepts:
+    - a map-style dataset (``__len__`` + ``__getitem__``),
+    - an iterable dataset (no ``__len__``),
+    - a torch ``DataLoader`` (its dataset/sampler config is re-derived),
+    - an existing prepared loader (returned unchanged).
+    """
+    if isinstance(dataloader_or_dataset, BaseDataLoader):
+        return dataloader_or_dataset
+
+    state = PartialState()
+
+    dataset = dataloader_or_dataset
+    # torch DataLoader interop: lift its config
+    if hasattr(dataset, "dataset") and hasattr(dataset, "batch_size") and not hasattr(dataset, "__getitem__"):
+        loader = dataset
+        dataset = loader.dataset
+        batch_size = batch_size or loader.batch_size
+        if drop_last is None:
+            drop_last = getattr(loader, "drop_last", False)
+        if collate_fn is None:
+            lcf = getattr(loader, "collate_fn", None)
+            # torch default_collate returns torch tensors; keep ours unless custom
+            if lcf is not None and type(lcf).__module__ != "torch.utils.data._utils.collate":
+                collate_fn = lcf
+        if shuffle is None:
+            sampler = getattr(loader, "sampler", None)
+            shuffle = type(sampler).__name__ == "RandomSampler"
+
+    batch_size = batch_size or 8
+    drop_last = bool(drop_last)
+    shuffle = bool(shuffle) if shuffle is not None else False
+    seed = 42 if seed is None else seed
+
+    indexable = hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__")
+
+    if dispatch_batches:
+        return DataLoaderDispatcher(
+            dataset,
+            batch_size=batch_size if not split_batches else batch_size // state.num_processes,
+            collate_fn=collate_fn,
+            device_placement=device_placement,
+            drop_last=drop_last,
+        )
+
+    if not indexable:
+        shard = IterableDatasetShard(
+            dataset,
+            batch_size=batch_size,
+            num_processes=state.num_processes,
+            process_index=state.process_index,
+            drop_last=drop_last,
+            split_batches=split_batches,
+        )
+        return IterableDataLoaderShard(shard, collate_fn=collate_fn, device_placement=device_placement)
+
+    n = len(dataset)
+    # Shuffling is always (seed, epoch)-derived: jax has no mutable global
+    # generator whose state a non-seedable sampler could consume, so
+    # use_seedable_sampler is accepted for API parity but there is only one
+    # (reproducible) shuffle implementation.
+    sampler = SeedableRandomSampler(n, seed=seed) if shuffle else SequentialSampler(n)
+    inner = BatchSampler(sampler, batch_size=batch_size, drop_last=drop_last)
+    shard = BatchSamplerShard(
+        inner,
+        num_processes=state.num_processes,
+        process_index=state.process_index,
+        split_batches=split_batches,
+        even_batches=even_batches,
+    )
+    return DataLoaderShard(
+        dataset,
+        batch_sampler=shard,
+        collate_fn=collate_fn,
+        device_placement=device_placement,
+        split_batches=split_batches,
+    )
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch resume
+# ---------------------------------------------------------------------------
+
+
+class SkipBatchSampler:
+    """Yields the inner batch sampler's batches after the first N (data_loader.py:981)."""
+
+    def __init__(self, batch_sampler, skip_batches: int = 0):
+        self.batch_sampler = batch_sampler
+        self.skip_batches = skip_batches
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
+    @property
+    def batch_size(self):
+        return getattr(self.batch_sampler, "batch_size", None)
+
+    @property
+    def num_processes(self):
+        return getattr(self.batch_sampler, "num_processes", 1)
+
+    @property
+    def split_batches(self):
+        return getattr(self.batch_sampler, "split_batches", False)
+
+    def __len__(self) -> int:
+        return max(len(self.batch_sampler) - self.skip_batches, 0)
+
+    def __iter__(self):
+        for i, batch in enumerate(self.batch_sampler):
+            if i >= self.skip_batches:
+                yield batch
+
+
+class SkipDataLoader(BaseDataLoader):
+    """Iterable-loader variant of batch skipping (data_loader.py:1026)."""
+
+    def __init__(self, inner_loader: BaseDataLoader, skip_batches: int):
+        super().__init__(device_placement=False)  # inner loader already globalizes
+        self.inner_loader = inner_loader
+        self.skip_batches = skip_batches
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_loader"], name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self.inner_loader):
+            if i >= self.skip_batches:
+                yield batch
+
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """Resume mid-epoch: a loader equivalent to ``dataloader`` minus its first
+    ``num_batches`` batches (reference data_loader.py:1026-1093)."""
+    if num_batches == 0:
+        return dataloader
+    if isinstance(dataloader, DataLoaderShard):
+        return DataLoaderShard(
+            dataloader.dataset,
+            batch_sampler=SkipBatchSampler(dataloader.batch_sampler, num_batches),
+            collate_fn=dataloader.collate_fn,
+            device_placement=dataloader.device_placement,
+            split_batches=dataloader.split_batches,
+        )
+    return SkipDataLoader(dataloader, num_batches)

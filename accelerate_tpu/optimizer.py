@@ -1,0 +1,201 @@
+"""Optimizer wrapper over optax with accumulation, loss scaling, and sharding.
+
+Parity: reference optimizer.py — AcceleratedOptimizer (38): step/zero_grad
+gating on ``sync_gradients`` (112-144), GradScaler overflow-skip detection
+(145-159), ``step_was_skipped`` (180). The XLA-specific pre-step grad
+all-reduce (optimizer.py:136-143) disappears: grads come out of a jit whose
+batch input is sharded over the data axes, so XLA already reduced them.
+
+Mechanics: gradients are *accumulated* into a sharded buffer by
+``Accelerator.backward`` (mean over the accumulation window); ``step()`` runs
+one jit-compiled update (unscale → finite-check → clip → optax update) with
+params/opt_state donated, and is a no-op while ``sync_gradients`` is False.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .state import AcceleratorState, GradientState
+from .utils.dataclasses import LossScaleKwargs
+
+
+class AcceleratedOptimizer:
+    def __init__(
+        self,
+        tx,  # optax.GradientTransformation
+        params_box,  # ParamBox shared with the PreparedModel
+        params_shardings: Any,
+        scaler: Optional[LossScaleKwargs] = None,
+        clip_grad_norm: Optional[float] = None,
+    ):
+        import optax
+
+        self.tx = tx
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState()
+        self.scaler = scaler
+        self._box = params_box
+        self._params_shardings = params_shardings
+
+        from .parallel.sharding import replicated, shardings_like
+
+        mesh = self.accelerator_state.mesh
+        params = self._box.value
+        state_shapes = jax.eval_shape(tx.init, params)
+        self._opt_state_shardings = shardings_like(state_shapes, params, params_shardings, mesh)
+        self.opt_state = jax.jit(tx.init, out_shardings=self._opt_state_shardings)(params)
+
+        self._grads = None  # accumulated (sum) grads, lazily allocated
+        self._accum_count = 0
+        self._step_count = 0
+        self._skipped = jnp.asarray(False)
+        if scaler is not None:
+            rep = replicated(mesh)
+            self.scale = jax.device_put(jnp.float32(scaler.init_scale), rep)
+            self.growth_tracker = jax.device_put(jnp.int32(0), rep)
+        else:
+            self.scale = None
+            self.growth_tracker = None
+
+        self._add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b), donate_argnums=(0,))
+        self._update_fn = None  # built lazily per clip-norm setting
+        self._pending_clip_norm = clip_grad_norm
+
+    # -- gradient intake (called by Accelerator.backward) -------------------
+
+    def accumulate_grads(self, grads: Any) -> None:
+        if self._grads is None:
+            self._grads = grads
+        else:
+            self._grads = self._add_fn(self._grads, grads)
+        self._accum_count += 1
+
+    @property
+    def grads(self) -> Any:
+        """Current accumulated gradient (mean over the window so far), unscaled."""
+        if self._grads is None:
+            return None
+        count = jnp.float32(self._accum_count)
+        scale = self.scale if self.scale is not None else jnp.float32(1.0)
+        return jax.tree.map(lambda g: g.astype(jnp.float32) / (count * scale), self._grads)
+
+    def set_clip_grad_norm(self, max_norm: Optional[float]) -> None:
+        if max_norm != self._pending_clip_norm:
+            self._pending_clip_norm = max_norm
+            self._update_fn = None  # different constant → recompile
+
+    # -- the update --------------------------------------------------------
+
+    def _build_update_fn(self):
+        import optax
+
+        clip_norm = self._pending_clip_norm
+        use_scaler = self.scaler is not None
+        scaler_cfg = self.scaler
+
+        def update(params, opt_state, grads, accum_count, scale, growth_tracker):
+            denom = accum_count.astype(jnp.float32) * (scale if use_scaler else jnp.float32(1.0))
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
+            if clip_norm is not None:
+                gnorm = optax.global_norm(grads)
+                factor = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            else:
+                gnorm = optax.global_norm(grads)
+
+            if use_scaler:
+                finite = jnp.isfinite(gnorm)
+
+                def do_update(args):
+                    params, opt_state, grads = args
+                    updates, new_state = self.tx.update(grads, opt_state, params)
+                    return optax.apply_updates(params, updates), new_state
+
+                params, opt_state = jax.lax.cond(
+                    finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
+                )
+                # dynamic loss-scale bookkeeping (reference: GradScaler semantics)
+                growth_tracker = jnp.where(finite, growth_tracker + 1, 0)
+                grew = growth_tracker >= scaler_cfg.growth_interval
+                scale = jnp.where(
+                    finite,
+                    jnp.where(grew, scale * scaler_cfg.growth_factor, scale),
+                    scale * scaler_cfg.backoff_factor,
+                )
+                growth_tracker = jnp.where(grew, 0, growth_tracker)
+                skipped = ~finite
+            else:
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                skipped = jnp.asarray(False)
+            return params, opt_state, scale, growth_tracker, skipped, gnorm
+
+        return jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def step(self) -> None:
+        if not self.gradient_state.sync_gradients or self._grads is None:
+            return
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        scale = self.scale if self.scale is not None else jnp.float32(1.0)
+        growth = self.growth_tracker if self.growth_tracker is not None else jnp.int32(0)
+        (
+            self._box.value,
+            self.opt_state,
+            scale,
+            growth,
+            self._skipped,
+            self._last_grad_norm,
+        ) = self._update_fn(
+            self._box.value, self.opt_state, self._grads, jnp.int32(self._accum_count), scale, growth
+        )
+        if self.scaler is not None:
+            self.scale, self.growth_tracker = scale, growth
+        self._grads = None
+        self._accum_count = 0
+        self._step_count += 1
+
+    def zero_grad(self, set_to_none: bool = True) -> None:  # noqa: ARG002 - parity
+        if self.gradient_state.sync_gradients:
+            self._grads = None
+            self._accum_count = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def params(self) -> Any:
+        return self._box.value
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """Whether the last ``step`` was skipped due to non-finite grads."""
+        if self.scaler is None:
+            return False  # structurally impossible; avoid a device sync per step
+        return bool(self._skipped)
+
+    @property
+    def step_count(self) -> int:
+        return self._step_count
+
+    def state_dict(self) -> dict:
+        state = {"opt_state": self.opt_state, "step_count": self._step_count}
+        if self.scaler is not None:
+            state["scale"] = self.scale
+            state["growth_tracker"] = self.growth_tracker
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        self.opt_state = jax.tree.map(
+            lambda s, x: jax.device_put(jnp.asarray(x), s), self._opt_state_shardings, state["opt_state"]
+        )
+        self._step_count = int(state.get("step_count", 0))
+        if self.scaler is not None and "scale" in state:
+            self.scale = jnp.float32(state["scale"])
+            self.growth_tracker = jnp.int32(state["growth_tracker"])

@@ -1,0 +1,9 @@
+from .sharding import (
+    PartitionRules,
+    fsdp_auto_spec,
+    infer_shardings,
+    param_path,
+    replicated,
+    shard_tree,
+    shardings_like,
+)

@@ -1,0 +1,179 @@
+"""Partition-rule engine: from param pytrees to NamedShardings.
+
+This is the single sharding engine that replaces the reference's four native
+runtimes (DDP wrapper accelerator.py:1418, DeepSpeed ZeRO accelerator.py:1486,
+FSDP accelerator.py:1421-1468, Megatron TP utils/megatron_lm.py): every
+strategy is just a different assignment of array dimensions to mesh axes, and
+XLA emits the matching collectives (all-gather on use, reduce-scatter on grad)
+under GSPMD.
+
+Rules are (regex, PartitionSpec-tuple) pairs matched against the pytree path
+of each parameter ("layers/3/attn/wq"). First match wins. Unmatched params fall
+back to the FSDP auto-rule (shard the largest divisible dim over the ``fsdp``
+axis when the tensor is big enough) or replication.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils.constants import MESH_AXIS_FSDP
+from ..utils.dataclasses import FullyShardedDataParallelPlugin
+
+
+def param_path(key_path) -> str:
+    """jax.tree_util key path → "a/b/0/c" string."""
+    parts = []
+    for entry in key_path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for a in axis:
+            size *= mesh.shape[a]
+        return size
+    return mesh.shape[axis]
+
+
+def _spec_fits(shape: tuple[int, ...], spec: Sequence, mesh: Mesh) -> bool:
+    if len(spec) > len(shape):
+        return False
+    for dim, axis in zip(shape, spec):
+        size = _axis_size(mesh, axis)
+        if size > 1 and dim % size != 0:
+            return False
+    return True
+
+
+def fsdp_auto_spec(
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    plugin: Optional[FullyShardedDataParallelPlugin] = None,
+    taken_axes: Sequence[str] = (),
+) -> PartitionSpec:
+    """Shard the largest divisible dim over ``fsdp`` (ZeRO-3 layout).
+
+    Mirrors the effect of FSDP's flat-param sharding / DeepSpeed ZeRO-3
+    partitioning without flattening: per-tensor dim sharding composes with TP
+    and keeps matmul layouts MXU-friendly.
+    """
+    fsdp_size = mesh.shape.get(MESH_AXIS_FSDP, 1)
+    if fsdp_size <= 1:
+        return PartitionSpec()
+    min_size = plugin.min_weight_size if plugin is not None else 2**12
+    total = int(np.prod(shape)) if shape else 0
+    if total < min_size:
+        return PartitionSpec()
+    # prefer the largest dim not already sharded by an explicit (TP) axis
+    order = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for dim in order:
+        if shape[dim] % fsdp_size == 0:
+            spec = [None] * len(shape)
+            spec[dim] = MESH_AXIS_FSDP
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+class PartitionRules:
+    """Ordered (regex, spec) table with FSDP auto-fallback."""
+
+    def __init__(
+        self,
+        rules: Sequence[tuple[str, tuple]] = (),
+        fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+        combine_fsdp: bool = True,
+    ):
+        self.rules = [(re.compile(pattern), tuple(spec)) for pattern, spec in rules]
+        self.fsdp_plugin = fsdp_plugin
+        self.combine_fsdp = combine_fsdp
+
+    def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+        for pattern, spec in self.rules:
+            if pattern.search(path):
+                if not _spec_fits(shape, spec, mesh):
+                    break  # rule exists but doesn't divide: fall back to auto
+                spec = list(spec) + [None] * (len(shape) - len(spec))
+                if self.combine_fsdp and mesh.shape.get(MESH_AXIS_FSDP, 1) > 1:
+                    spec = self._fold_in_fsdp(shape, spec, mesh)
+                return PartitionSpec(*spec)
+        return fsdp_auto_spec(shape, mesh, self.fsdp_plugin)
+
+    def _fold_in_fsdp(self, shape, spec, mesh) -> list:
+        """Also shard an explicit-TP param over fsdp on a free dim (2D sharding,
+        the megatron+zero3 combination)."""
+        fsdp_size = mesh.shape[MESH_AXIS_FSDP]
+        total = int(np.prod(shape)) if shape else 0
+        min_size = self.fsdp_plugin.min_weight_size if self.fsdp_plugin else 2**12
+        if total < min_size:
+            return spec
+        for dim in sorted(range(len(shape)), key=lambda i: shape[i], reverse=True):
+            if spec[dim] is None and shape[dim] % fsdp_size == 0:
+                spec[dim] = MESH_AXIS_FSDP
+                return spec
+        return spec
+
+
+def infer_shardings(
+    tree: Any,
+    mesh: Mesh,
+    rules: Optional[PartitionRules] = None,
+) -> Any:
+    """Tree of arrays/ShapeDtypeStructs → tree of NamedSharding."""
+    rules = rules or PartitionRules()
+
+    def _leaf(key_path, leaf):
+        path = param_path(key_path)
+        spec = rules.spec_for(path, tuple(leaf.shape), mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(_leaf, tree)
+
+
+def shard_tree(tree: Any, shardings: Any) -> Any:
+    """device_put every leaf to its sharding (the actual H2D/placement step)."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shardings_like(state_shapes: Any, params: Any, params_shardings: Any, mesh: Mesh) -> Any:
+    """Shardings for an optimizer-state tree: leaves whose shape matches a
+    param reuse that param's sharding (Adam moments); everything else is
+    replicated (step counters, scalars).
+
+    ``state_shapes`` is a tree of ShapeDtypeStructs from
+    ``jax.eval_shape(tx.init, params)``. Matching is by shape — exact for the
+    moment buffers optax keeps as param-tree copies, conservative (replicate)
+    for anything else.
+    """
+    lookup: dict[tuple, NamedSharding] = {}
+    for p_leaf, s_leaf in zip(jax.tree.leaves(params), jax.tree.leaves(params_shardings)):
+        lookup.setdefault(tuple(p_leaf.shape), s_leaf)
+
+    def _leaf(leaf):
+        sharding = lookup.get(tuple(leaf.shape))
+        if sharding is not None and len(leaf.shape) > 0:
+            return sharding
+        return replicated(mesh)
+
+    return jax.tree.map(_leaf, state_shapes)

@@ -1,0 +1,63 @@
+"""Learning-rate schedule wrapper.
+
+Parity: reference scheduler.py — AcceleratedScheduler (25): steps only when
+the optimizer actually stepped (61-68), optional num_processes compensation
+when ``split_batches=False`` (73-82).
+
+In optax the schedule is a pure function of the update count and is usually
+baked into the transformation; this wrapper exists so user loops keep the
+familiar ``scheduler.step()`` / ``get_last_lr()`` shape and so checkpoints
+carry the schedule position explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        schedule_fn: Callable[[int], float],
+        optimizer=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.schedule_fn = schedule_fn
+        self.optimizer = optimizer
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+        self._counter = 0
+
+    def step(self) -> None:
+        if not self.step_with_optimizer:
+            self._counter += 1
+            return
+        if not self.gradient_state.sync_gradients:
+            return  # optimizer didn't step on this accumulation micro-step
+        if self.optimizer is not None and self.optimizer.step_was_skipped:
+            return  # fp16 overflow: optimizer didn't move, neither does the schedule
+        if self.split_batches:
+            self._counter += 1
+        else:
+            # One SPMD process == the whole data-parallel group, but schedules
+            # written for per-worker semantics expect num_processes ticks per
+            # global step (reference scheduler.py:73-82).
+            num = AcceleratorState().num_processes
+            self._counter += num
+
+    def get_last_lr(self) -> list[float]:
+        return [float(self.schedule_fn(self._counter))]
+
+    @property
+    def step_count(self) -> int:
+        return self._counter
+
+    def state_dict(self) -> dict:
+        return {"counter": self._counter}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._counter = int(state["counter"])

@@ -1,0 +1,246 @@
+"""Experiment trackers.
+
+Parity: reference tracking.py — GeneralTracker ABC (91) with
+requires_logging_directory / main_process_only / lifecycle
+(store_init_configuration, log, finish), concrete trackers (165-970),
+filter_trackers (971).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+_available_trackers: dict[str, type] = {}
+
+
+def register_tracker(cls):
+    _available_trackers[cls.name] = cls
+    return cls
+
+
+def on_main_process(method):
+    def wrapper(self, *args, **kwargs):
+        if not getattr(self, "main_process_only", True) or PartialState().is_main_process:
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
+
+class GeneralTracker:
+    """Base tracker API (reference tracking.py:91-163)."""
+
+    name: str = "general"
+    requires_logging_directory: bool = False
+    main_process_only: bool = True
+
+    def store_init_configuration(self, values: dict) -> None:
+        raise NotImplementedError
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        pass
+
+    @property
+    def tracker(self):
+        return getattr(self, "writer", self)
+
+
+@register_tracker
+class TensorBoardTracker(GeneralTracker):
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.add_hparams(values, metric_dict={})
+        self.writer.flush()
+        with open(os.path.join(self.logging_dir, "hparams.json"), "w") as f:
+            json.dump(values, f, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+@register_tracker
+class WandBTracker(GeneralTracker):
+    name = "wandb"
+    main_process_only = True
+
+    def __init__(self, run_name: str, **kwargs):
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+    @property
+    def tracker(self):
+        return self.run
+
+
+@register_tracker
+class MLflowTracker(GeneralTracker):
+    name = "mlflow"
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        import mlflow
+
+        self.run = mlflow.start_run(run_name=run_name, **kwargs)
+        self.writer = mlflow
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import mlflow
+
+        for k, v in values.items():
+            mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        import mlflow
+
+        mlflow.end_run()
+
+
+@register_tracker
+class JSONLTracker(GeneralTracker):
+    """Dependency-free tracker writing metrics as JSON lines — the default
+    when no external tracker is installed (net-new; useful on TPU pods where
+    hosts have no network egress)."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        self.run_name = run_name
+        os.makedirs(os.path.join(logging_dir, run_name), exist_ok=True)
+        self.path = os.path.join(logging_dir, run_name, "metrics.jsonl")
+        self._file = open(self.path, "a")
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self._file.write(json.dumps({"_config": values, "_time": time.time()}, default=str) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        record = {**values, "_step": step, "_time": time.time()}
+        self._file.write(json.dumps(record, default=str) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self._file.close()
+
+
+_AVAILABILITY = {
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "jsonl": lambda: True,
+}
+
+
+def filter_trackers(
+    log_with,
+    logging_dir: Optional[str],
+    project_name: str,
+    config: Optional[dict] = None,
+    init_kwargs: Optional[dict] = None,
+) -> list[GeneralTracker]:
+    """Resolve tracker names ("all" included) to live instances (tracking.py:971)."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    init_kwargs = init_kwargs or {}
+
+    names: list[str] = []
+    instances: list[GeneralTracker] = []
+    for item in log_with:
+        if isinstance(item, GeneralTracker):
+            instances.append(item)
+        elif str(item) == "all":
+            names.extend(name for name, avail in _AVAILABILITY.items() if avail())
+        else:
+            names.append(str(item))
+
+    for name in dict.fromkeys(names):
+        if name not in _available_trackers:
+            raise ValueError(f"Unknown tracker {name!r}; available: {sorted(_available_trackers)}")
+        avail = _AVAILABILITY.get(name, lambda: True)
+        if not avail():
+            logger.warning(f"Tracker {name} requested but its package is not installed; skipping.")
+            continue
+        cls = _available_trackers[name]
+        kwargs = dict(init_kwargs.get(name, {}))
+        if cls.requires_logging_directory:
+            if logging_dir is None:
+                raise ValueError(f"Tracker {name} requires a logging_dir (set project_dir).")
+            instances.append(cls(project_name, logging_dir=logging_dir, **kwargs))
+        else:
+            instances.append(cls(project_name, **kwargs))
+    for tracker in instances:
+        if config:
+            tracker.store_init_configuration(config)
+    return instances

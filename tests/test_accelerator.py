@@ -1,0 +1,281 @@
+"""End-to-end Accelerator tests: training parity, accumulation, clipping,
+checkpoint round-trip (reference tests/test_accelerator.py + test_script.py)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, GradientAccumulationPlugin, ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+
+class ArrayDataset:
+    def __init__(self, x, y):
+        self.x, self.y = x, y
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def _make_data(n=64, seed=0):
+    ds = RegressionDataset(length=n, seed=seed)
+    return ArrayDataset(ds.x, ds.y)
+
+
+class LinearModel:
+    """Minimal model with init/apply protocol."""
+
+    def init(self, rng):
+        del rng
+        return {"a": jnp.zeros((), jnp.float32), "b": jnp.zeros((), jnp.float32)}
+
+    @staticmethod
+    def apply(params, x):
+        return params["a"] * x + params["b"]
+
+
+def loss_fn(params, batch):
+    pred = LinearModel.apply(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def train(accelerator, epochs=3, lr=0.1, clip=None, batch_size=16):
+    model, optimizer, loader = accelerator.prepare(
+        LinearModel(), optax.sgd(lr), _make_data()
+    )
+    # loader got default batch size 8
+    losses = []
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(loss_fn, batch)
+                if clip:
+                    accelerator.clip_grad_norm_(model, clip)
+                optimizer.step()
+                optimizer.zero_grad()
+            losses.append(float(loss))
+    return model, losses
+
+
+def test_training_decreases_loss():
+    accelerator = Accelerator()
+    model, losses = train(accelerator)
+    assert losses[-1] < losses[0] * 0.2
+    # recovered approximately y = 2x + 3
+    params = jax.device_get(model.params)
+    assert abs(float(params["a"]) - 2.0) < 0.5
+    assert abs(float(params["b"]) - 3.0) < 0.5
+
+
+def test_training_parity_single_vs_mesh():
+    """Distributed run must match the math of a plain single-device loop
+    (reference test_script.py training parity)."""
+    accelerator = Accelerator()
+    model, _ = train(accelerator, epochs=2)
+    dist_params = jax.device_get(model.params)
+
+    # plain jax reference loop, same batches (sequential sampler, batch 8)
+    data = _make_data()
+    params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        g = jax.grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    for _ in range(2):
+        for start in range(0, 64, 8):
+            batch = {
+                "x": jnp.asarray(data.x[start : start + 8]),
+                "y": jnp.asarray(data.y[start : start + 8]),
+            }
+            params, opt_state = step(params, opt_state, batch)
+    np.testing.assert_allclose(float(dist_params["a"]), float(params["a"]), rtol=1e-5)
+    np.testing.assert_allclose(float(dist_params["b"]), float(params["b"]), rtol=1e-5)
+
+
+def test_gradient_accumulation_equivalence():
+    """accum=4 with lr applied at sync must equal large-batch steps."""
+    accelerator = Accelerator(gradient_accumulation_steps=4)
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    steps = 0
+    for batch in loader:  # 8 batches of 8
+        with accelerator.accumulate(model):
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        steps += 1
+    assert optimizer.step_count == 2  # 8 batches / accum 4
+
+    # reference: same data in 2 batches of 32
+    data = _make_data()
+    params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    for start in (0, 32):
+        batch = {"x": jnp.asarray(data.x[start : start + 32]), "y": jnp.asarray(data.y[start : start + 32])}
+        g = jax.grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    got = jax.device_get(model.params)
+    np.testing.assert_allclose(float(got["a"]), float(params["a"]), rtol=1e-5)
+    np.testing.assert_allclose(float(got["b"]), float(params["b"]), rtol=1e-5)
+
+
+def test_accumulation_respects_end_of_dataloader():
+    """Partial final window still steps (sync_with_dataloader)."""
+    accelerator = Accelerator(gradient_accumulation_steps=3)
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    for batch in loader:  # 8 batches, 3-accum -> steps at 3, 6, and end (8)
+        with accelerator.accumulate(model):
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert optimizer.step_count == 3
+
+
+def test_clip_grad_norm():
+    accelerator = Accelerator()
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.01), _make_data())
+    batch = next(iter(loader))
+    accelerator.backward(loss_fn, batch)
+    accelerator.clip_grad_norm_(model, 0.001)
+    before = jax.device_get(model.params)
+    optimizer.step()
+    after = jax.device_get(model.params)
+    # update magnitude bounded by lr * clip
+    delta = abs(float(after["a"]) - float(before["a"])) + abs(float(after["b"]) - float(before["b"]))
+    assert delta <= 0.01 * 0.001 * 2 + 1e-9
+
+
+def test_fp16_loss_scaling_runs():
+    accelerator = Accelerator(mixed_precision="fp16")
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.05), _make_data())
+    for batch in loader:
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert np.isfinite(float(loss))
+    assert optimizer.scale is not None
+    assert not optimizer.step_was_skipped
+
+
+def test_bf16_policy_compute_dtype():
+    accelerator = Accelerator(mixed_precision="bf16")
+
+    captured = {}
+
+    def probe_loss(params, batch):
+        captured["param_dtype"] = params["a"].dtype
+        captured["x_dtype"] = batch["x"].dtype
+        pred = LinearModel.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    batch = next(iter(loader))
+    loss = accelerator.backward(probe_loss, batch)
+    assert captured["param_dtype"] == jnp.bfloat16
+    assert captured["x_dtype"] == jnp.bfloat16
+    assert loss.dtype == jnp.float32
+    # master params stay fp32
+    assert model.params["a"].dtype == jnp.float32
+
+
+def test_compiled_step_matches_eager():
+    a1 = Accelerator()
+    model, optimizer, loader = a1.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    step = a1.compiled_step(loss_fn)
+    for batch in loader:
+        loss = step(batch)
+    fused = jax.device_get(model.params)
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    a2 = Accelerator()
+    model2, optimizer2, loader2 = a2.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    for batch in loader2:
+        with a2.accumulate(model2):
+            a2.backward(loss_fn, batch)
+            optimizer2.step()
+            optimizer2.zero_grad()
+    eager = jax.device_get(model2.params)
+    np.testing.assert_allclose(float(fused["a"]), float(eager["a"]), rtol=1e-5)
+    np.testing.assert_allclose(float(fused["b"]), float(eager["b"]), rtol=1e-5)
+
+
+def test_gather_for_metrics_dedups_padding():
+    accelerator = Accelerator()
+    loader = accelerator.prepare(_make_data(n=20))  # batch 8 -> remainder 4
+    seen = []
+    for batch in loader:
+        preds = batch["x"]
+        gathered = accelerator.gather_for_metrics(preds)
+        seen.append(np.asarray(gathered))
+    total = np.concatenate(seen)
+    assert total.shape[0] == 20  # no duplicated padded samples
+
+
+def test_save_load_state_roundtrip(tmp_path):
+    accelerator = Accelerator()
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.adam(0.1), _make_data())
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        break
+    params_before = jax.device_get(model.params)
+    opt_before = jax.device_get(jax.tree.leaves(optimizer.opt_state))
+    accelerator.save_state(str(tmp_path / "ckpt"))
+
+    # keep training, then restore
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    params_after = jax.device_get(model.params)
+    np.testing.assert_allclose(float(params_after["a"]), float(params_before["a"]))
+    np.testing.assert_allclose(float(params_after["b"]), float(params_before["b"]))
+    for a, b in zip(opt_before, jax.device_get(jax.tree.leaves(optimizer.opt_state))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_scheduler_steps_with_optimizer():
+    accelerator = Accelerator(gradient_accumulation_steps=2)
+    schedule = optax.linear_schedule(1.0, 0.0, 100)
+    model, optimizer, loader, scheduler = accelerator.prepare(
+        LinearModel(), optax.sgd(0.1), _make_data(), schedule
+    )
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            scheduler.step()
+            optimizer.zero_grad()
+    # 8 batches / accum 2 = 4 optimizer steps; num_processes=1
+    assert scheduler.step_count == 4
+    assert scheduler.get_last_lr()[0] == pytest.approx(1.0 - 4 / 100)
+
+
+def test_trigger_primitive():
+    accelerator = Accelerator()
+    assert not accelerator.check_trigger()
+    accelerator.set_trigger()
+    assert accelerator.check_trigger()
+    assert not accelerator.check_trigger()  # reset after firing

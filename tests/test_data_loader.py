@@ -1,0 +1,165 @@
+"""Sampler-shard arithmetic and loader behavior (reference tests/test_data_loader.py)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SequentialSampler,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import GradientState, PartialState
+
+
+def make_batch_sampler(n, batch_size, drop_last=False):
+    return BatchSampler(SequentialSampler(n), batch_size=batch_size, drop_last=drop_last)
+
+
+def shards_for(n, batch_size, num_processes, split_batches=False, even_batches=True, drop_last=False):
+    inner_bs = batch_size * (num_processes if split_batches else 1)
+    return [
+        list(
+            BatchSamplerShard(
+                make_batch_sampler(n, inner_bs, drop_last),
+                num_processes=num_processes,
+                process_index=p,
+                split_batches=split_batches,
+                even_batches=even_batches,
+            )
+        )
+        for p in range(num_processes)
+    ]
+
+
+def test_round_robin_even_split():
+    # 16 samples, batch 2, 2 procs: 8 batches round-robin -> 4 each
+    shards = shards_for(16, 2, 2)
+    assert shards[0] == [[0, 1], [4, 5], [8, 9], [12, 13]]
+    assert shards[1] == [[2, 3], [6, 7], [10, 11], [14, 15]]
+
+
+def test_round_robin_uneven_pads_from_start():
+    # 10 samples, batch 2, 2 procs -> 5 batches; final window padded by cycling
+    shards = shards_for(10, 2, 2)
+    assert all(len(b) == 2 for shard in shards for b in shard)
+    # same number of batches per process
+    assert len(shards[0]) == len(shards[1])
+    # all original indices appear
+    seen = {i for shard in shards for b in shard for i in b}
+    assert seen == set(range(10))
+
+
+def test_round_robin_drop_last():
+    shards = shards_for(10, 2, 2, even_batches=False, drop_last=True)
+    assert len(shards[0]) == len(shards[1]) == 2
+    seen = {i for shard in shards for b in shard for i in b}
+    assert seen == set(range(8))
+
+
+def test_split_batches_mode():
+    shards = shards_for(16, 2, 2, split_batches=True)
+    # inner batch size = 4, each proc takes its slice of every batch
+    assert shards[0][0] == [0, 1]
+    assert shards[1][0] == [2, 3]
+    assert len(shards[0]) == 4
+
+
+def test_split_batches_indivisible_raises():
+    sampler = make_batch_sampler(16, 3)
+    with pytest.raises(ValueError):
+        BatchSamplerShard(sampler, num_processes=2, process_index=0, split_batches=True)
+
+
+def test_iterable_dataset_shard():
+    data = list(range(11))
+    shards = [
+        list(
+            IterableDatasetShard(
+                data, batch_size=2, num_processes=2, process_index=p, drop_last=False
+            )
+        )
+        for p in range(2)
+    ]
+    # each buffer of 4 split 2/2; last partial buffer padded from the first
+    assert len(shards[0]) == len(shards[1])
+    combined = set(shards[0]) | set(shards[1])
+    assert set(range(11)).issubset(combined)
+
+
+def test_seedable_sampler_deterministic():
+    s1 = SeedableRandomSampler(10, seed=7)
+    s2 = SeedableRandomSampler(10, seed=7)
+    s1.set_epoch(3)
+    s2.set_epoch(3)
+    assert list(s1) == list(s2)
+    s2.set_epoch(4)
+    assert list(s1) != list(s2)
+
+
+class DictDataset:
+    def __init__(self, n):
+        self.x = np.arange(n, dtype=np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": 2 * self.x[i]}
+
+
+def test_dataloader_shard_global_arrays():
+    loader = prepare_data_loader(DictDataset(32), batch_size=8)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0]["x"].shape == (8,)
+    # batch is a global sharded jax array over the 8-device mesh
+    assert len(batches[0]["x"].sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(batches[0]["x"]), np.arange(8, dtype=np.float32))
+
+
+def test_dataloader_end_of_dataloader_flag():
+    gs = GradientState()
+    loader = prepare_data_loader(DictDataset(16), batch_size=8)
+    flags = []
+    for _ in loader:
+        flags.append(loader.end_of_dataloader)
+    assert flags == [False, True]
+    assert not gs.in_dataloader  # cleanly removed after epoch
+
+
+def test_dataloader_remainder():
+    loader = prepare_data_loader(DictDataset(20), batch_size=8)
+    rems = []
+    for _ in loader:
+        rems.append(loader.remainder)
+    assert rems[-1] == 20 % 8  # 4 real samples in last global batch
+
+
+def test_skip_first_batches():
+    loader = prepare_data_loader(DictDataset(32), batch_size=8)
+    skipped = skip_first_batches(loader, 2)
+    batches = list(skipped)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(np.asarray(batches[0]["x"]), np.arange(16, 24, dtype=np.float32))
+
+
+def test_shuffle_epochs_differ():
+    loader = prepare_data_loader(DictDataset(32), batch_size=8, shuffle=True, seed=0)
+    loader.set_epoch(0)
+    e0 = [np.asarray(b["x"]) for b in loader]
+    loader.set_epoch(1)
+    e1 = [np.asarray(b["x"]) for b in loader]
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+    # all samples covered each epoch
+    assert set(np.concatenate(e0).tolist()) == set(range(32))
+
+
+def test_total_batch_size():
+    loader = prepare_data_loader(DictDataset(32), batch_size=4)
+    # single process: total == per-process
+    assert loader.total_batch_size == 4
