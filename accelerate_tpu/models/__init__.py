@@ -1,1 +1,15 @@
+from .attention import dot_product_attention, rotary_embedding
+from .bert import Bert
 from .config import TransformerConfig, get_config, list_models, param_count, register_config
+from .llama import Llama
+
+
+_ARCHS = {"llama": Llama, "bert": Bert}
+
+
+def build_model(name: str):
+    """Registry name → model instance (e.g. "llama-7b", "bert-base")."""
+    config = get_config(name)
+    if config.arch not in _ARCHS:
+        raise ValueError(f"Unknown arch {config.arch!r}; available: {sorted(_ARCHS)}")
+    return _ARCHS[config.arch](config)

@@ -1,0 +1,76 @@
+"""Attention primitives shared by the model zoo.
+
+The default path is einsum attention, which XLA fuses well on TPU (softmax
+rides the VPU, matmuls the MXU). A Pallas splash/ring kernel plugs in behind
+the same signature for long sequences (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(key: jax.Array, shape: tuple, fan_in: int) -> jax.Array:
+    """Scaled-normal initializer shared by the model zoo."""
+    return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(jnp.float32)
+
+
+def dropout(x: jax.Array, rate: float, rng: Optional[jax.Array]) -> jax.Array:
+    """Inverted dropout; identity when ``rng`` is None (eval) or rate == 0."""
+    if rng is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def rotary_embedding(positions: jax.Array, head_dim: int, theta: float = 10000.0, dtype=jnp.float32):
+    """RoPE cos/sin tables for ``positions`` [..., S] → two [..., S, D/2] arrays."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply RoPE to [..., S, N, D] given [..., S, D/2] tables."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[..., None, :]  # broadcast over heads
+    sin = sin[..., None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, S, N, D]
+    k: jax.Array,  # [B, T, K, D]
+    v: jax.Array,  # [B, T, K, D]
+    mask: Optional[jax.Array] = None,  # [B, 1, S, T] or broadcastable, True = attend
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Grouped-query attention; softmax in fp32 for stability."""
+    b, s, n, d = q.shape
+    t, kv = k.shape[1], k.shape[2]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    if n != kv:
+        group = n // kv
+        q = q.reshape(b, s, kv, group, d)
+        logits = jnp.einsum("bskgd,btkd->bkgst", q * scale, k)
+        logits = logits.reshape(b, n, s, t)
+    else:
+        logits = jnp.einsum("bsnd,btnd->bnst", q * scale, k)
+    logits = logits.astype(jnp.float32)
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if n != kv:
+        group = n // kv
+        probs_g = probs.reshape(b, kv, group, s, t)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs_g, v).reshape(b, s, n, d)
+    else:
+        out = jnp.einsum("bnst,btnd->bsnd", probs, v)
+    return out

@@ -1,0 +1,167 @@
+"""BERT-family encoder for sequence classification, TPU-first.
+
+Capability parity: the reference's canonical example trains
+bert-base-uncased on GLUE-MRPC (examples/nlp_example.py); this is that model
+rebuilt on the stacked-layer/scan design of models/llama.py. BASELINE.json
+target metric #1 (steps/sec/chip) runs on this.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.constants import MESH_AXIS_TENSOR
+from .attention import dense_init, dot_product_attention, dropout
+from .config import TransformerConfig, get_config
+from .llama import BATCH_AXES, _constrain
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+class Bert:
+    """(init, apply) pair for an encoder with a classification head."""
+
+    def __init__(self, config: TransformerConfig | str):
+        self.config = get_config(config) if isinstance(config, str) else config
+        assert self.config.arch == "bert"
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.config
+        h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+        keys = iter(jax.random.split(rng, 20))
+        dense = dense_init
+        return {
+            "embeddings": {
+                "word": jax.random.normal(next(keys), (v, h), jnp.float32) * 0.02,
+                "position": jax.random.normal(next(keys), (cfg.max_seq_len, h), jnp.float32) * 0.02,
+                "token_type": jax.random.normal(next(keys), (cfg.type_vocab_size, h), jnp.float32) * 0.02,
+                "norm_scale": jnp.ones((h,), jnp.float32),
+                "norm_bias": jnp.zeros((h,), jnp.float32),
+            },
+            "layers": {
+                "wq": dense(next(keys), (L, h, h), h),
+                "bq": jnp.zeros((L, h), jnp.float32),
+                "wk": dense(next(keys), (L, h, h), h),
+                "bk": jnp.zeros((L, h), jnp.float32),
+                "wv": dense(next(keys), (L, h, h), h),
+                "bv": jnp.zeros((L, h), jnp.float32),
+                "wo": dense(next(keys), (L, h, h), h),
+                "bo": jnp.zeros((L, h), jnp.float32),
+                "attn_norm_scale": jnp.ones((L, h), jnp.float32),
+                "attn_norm_bias": jnp.zeros((L, h), jnp.float32),
+                "w_up": dense(next(keys), (L, h, i), h),
+                "b_up": jnp.zeros((L, i), jnp.float32),
+                "w_down": dense(next(keys), (L, i, h), i),
+                "b_down": jnp.zeros((L, h), jnp.float32),
+                "mlp_norm_scale": jnp.ones((L, h), jnp.float32),
+                "mlp_norm_bias": jnp.zeros((L, h), jnp.float32),
+            },
+            "pooler": {"w": dense(next(keys), (h, h), h), "b": jnp.zeros((h,), jnp.float32)},
+            "classifier": {
+                "w": dense(next(keys), (h, cfg.num_labels), h),
+                "b": jnp.zeros((cfg.num_labels,), jnp.float32),
+            },
+        }
+
+    def partition_rules(self) -> list[tuple[str, tuple]]:
+        t = MESH_AXIS_TENSOR
+        return [
+            (r"embeddings/word", (t, None)),
+            (r"layers/(wq|wk|wv|w_up)", (None, None, t)),
+            (r"layers/(bq|bk|bv|b_up)", (None, t)),
+            (r"layers/(wo|w_down)", (None, t, None)),
+            (r"(norm|bias|bo|b_down)", (None,)),
+            (r"pooler/w", (None, t)),
+            (r"classifier", (None,)),
+        ]
+
+    def apply(
+        self,
+        params: dict,
+        input_ids: jax.Array,  # [B, S]
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+        position_ids: Optional[jax.Array] = None,
+        dropout_rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Classification logits [B, num_labels].
+
+        Pass ``dropout_rng`` during training to enable ``config.dropout_rate``
+        dropout (embeddings + each residual branch); omit it for eval.
+        """
+        cfg = self.config
+        b, s = input_ids.shape
+        nh = cfg.num_heads
+        d = cfg.hidden_size // nh
+
+        emb = params["embeddings"]
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (
+            jnp.take(emb["word"], input_ids, axis=0)
+            + jnp.take(emb["position"], position_ids, axis=0)
+            + jnp.take(emb["token_type"], token_type_ids, axis=0)
+        )
+        h = layer_norm(h, emb["norm_scale"], emb["norm_bias"], cfg.norm_eps)
+        h = _constrain(h, BATCH_AXES, None, None)
+        use_dropout = dropout_rng is not None and cfg.dropout_rate > 0.0
+        if use_dropout:
+            emb_rng, layers_rng = jax.random.split(dropout_rng)
+            h = dropout(h, cfg.dropout_rate, emb_rng)
+            layer_rngs = jax.random.split(layers_rng, cfg.num_layers * 2).reshape(cfg.num_layers, 2)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        def layer(h, xs):
+            lp = xs[0] if use_dropout else xs
+            rngs = xs[1] if use_dropout else (None, None)
+            q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, nh, d)
+            k = (h @ lp["wk"] + lp["bk"]).reshape(b, s, nh, d)
+            v = (h @ lp["wv"] + lp["bv"]).reshape(b, s, nh, d)
+            attn = dot_product_attention(q, k, v, mask=mask)
+            attn_out = attn.reshape(b, s, nh * d) @ lp["wo"] + lp["bo"]
+            if use_dropout:
+                attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
+            h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+            up = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
+            mlp_out = up @ lp["w_down"] + lp["b_down"]
+            if use_dropout:
+                mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
+            h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
+            return h, None
+
+        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+        h, _ = jax.lax.scan(layer, h, xs)
+        pooled = jnp.tanh(h[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+        return pooled @ params["classifier"]["w"] + params["classifier"]["b"]
+
+    @staticmethod
+    def loss_fn(model: "Bert"):
+        """Softmax CE over {input_ids, attention_mask?, token_type_ids?, labels}."""
+
+        def fn(params, batch):
+            logits = model.apply(
+                params,
+                batch["input_ids"],
+                batch.get("attention_mask"),
+                batch.get("token_type_ids"),
+            ).astype(jnp.float32)
+            labels = batch["labels"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+        return fn

@@ -1,0 +1,177 @@
+"""Llama-family decoder, TPU-first.
+
+Design (vs the reference's torch models, which it only orchestrates):
+- parameters are a flat pytree with layers *stacked* on a leading L axis so the
+  whole stack runs as one ``lax.scan`` — O(1) XLA program size in depth, and
+  partition specs apply uniformly to every layer.
+- attention/MLP projections carry explicit TP partition rules (megatron-style
+  column/row split) that the sharding engine folds with the fsdp axis.
+- activations get sharding constraints (batch over data axes, sequence over
+  the ``sequence`` axis) so GSPMD propagates the layout end to end.
+- bf16-friendly: RMSNorm and softmax accumulate in fp32.
+
+Capability parity: the model families the reference's examples/benchmarks
+exercise via transformers (GPT-J/NeoX/OPT/Llama — benchmarks/README.md:31-37,
+tests/fsdp Llama-7B) are covered by this one parametric family (config.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_FSDP, MESH_AXIS_SEQUENCE, MESH_AXIS_TENSOR
+from .attention import apply_rotary, dense_init, dot_product_attention, dropout, rotary_embedding
+from .config import TransformerConfig, get_config
+
+BATCH_AXES = (MESH_AXIS_DATA, MESH_AXIS_FSDP)
+
+
+def _constrain(x: jax.Array, *spec) -> jax.Array:
+    """Best-effort sharding constraint (no-op outside a mesh context)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+class Llama:
+    """(init, apply) pair for a llama-style causal LM."""
+
+    def __init__(self, config: TransformerConfig | str):
+        self.config = get_config(config) if isinstance(config, str) else config
+        assert self.config.arch == "llama"
+
+    # -- parameters --------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.config
+        h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        d, nh, nkv, L = cfg.dim_per_head, cfg.num_heads, cfg.kv_heads, cfg.num_layers
+        keys = iter(jax.random.split(rng, 16))
+        dense = dense_init
+        params = {
+            "embed_tokens": jax.random.normal(next(keys), (v, h), jnp.float32) * 0.02,
+            "layers": {
+                "attn_norm": jnp.ones((L, h), jnp.float32),
+                "wq": dense(next(keys), (L, h, nh * d), h),
+                "wk": dense(next(keys), (L, h, nkv * d), h),
+                "wv": dense(next(keys), (L, h, nkv * d), h),
+                "wo": dense(next(keys), (L, nh * d, h), nh * d),
+                "mlp_norm": jnp.ones((L, h), jnp.float32),
+                "w_gate": dense(next(keys), (L, h, i), h),
+                "w_up": dense(next(keys), (L, h, i), h),
+                "w_down": dense(next(keys), (L, i, h), i),
+            },
+            "final_norm": jnp.ones((h,), jnp.float32),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = dense(next(keys), (h, v), h)
+        return params
+
+    # -- sharding ----------------------------------------------------------
+
+    def partition_rules(self) -> list[tuple[str, tuple]]:
+        """Megatron-style TP: attention split by heads, MLP by intermediate;
+        row-parallel projections bring activations back (GSPMD inserts the
+        reduce). Leading dim of stacked layers is never sharded (scan axis)."""
+        t = MESH_AXIS_TENSOR
+        return [
+            (r"embed_tokens", (t, None)),          # vocab-parallel embedding
+            (r"layers/(wq|wk|wv)", (None, None, t)),  # column-parallel
+            (r"layers/wo", (None, t, None)),          # row-parallel
+            (r"layers/(w_gate|w_up)", (None, None, t)),
+            (r"layers/w_down", (None, t, None)),
+            (r"(attn_norm|mlp_norm|final_norm)", (None,)),
+            (r"lm_head", (None, t)),
+        ]
+
+    # -- forward -----------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        input_ids: jax.Array,  # [B, S] int32
+        attention_mask: Optional[jax.Array] = None,  # [B, S] 1=real
+        positions: Optional[jax.Array] = None,
+        dropout_rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Logits [B, S, V]. Pass ``dropout_rng`` to enable config.dropout_rate
+        residual dropout during training."""
+        cfg = self.config
+        b, s = input_ids.shape
+        d, nh, nkv = cfg.dim_per_head, cfg.num_heads, cfg.kv_heads
+
+        h = jnp.take(params["embed_tokens"], input_ids, axis=0)
+        h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        cos, sin = rotary_embedding(positions, d, cfg.rope_theta, dtype=h.dtype)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,T]
+
+        use_dropout = dropout_rng is not None and cfg.dropout_rate > 0.0
+        if use_dropout:
+            layer_rngs = jax.random.split(dropout_rng, cfg.num_layers * 2).reshape(cfg.num_layers, 2)
+
+        def layer(h, xs):
+            lp = xs[0] if use_dropout else xs
+            rngs = xs[1] if use_dropout else (None, None)
+            x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+            q = (x @ lp["wq"]).reshape(b, s, nh, d)
+            k = (x @ lp["wk"]).reshape(b, s, nkv, d)
+            v = (x @ lp["wv"]).reshape(b, s, nkv, d)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+            attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+            attn_out = attn.reshape(b, s, nh * d) @ lp["wo"]
+            if use_dropout:
+                attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
+            h = h + attn_out
+            x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+            gated = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+            mlp_out = gated @ lp["w_down"]
+            if use_dropout:
+                mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
+            h = h + mlp_out
+            h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
+            return h, None
+
+        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+        h, _ = jax.lax.scan(layer, h, xs)
+        h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+        head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = h @ head.astype(h.dtype)
+        return logits
+
+    # -- loss helper -------------------------------------------------------
+
+    @staticmethod
+    def loss_fn(model: "Llama"):
+        """Next-token cross-entropy over a batch {input_ids, [attention_mask]}."""
+
+        def fn(params, batch):
+            input_ids = batch["input_ids"]
+            logits = model.apply(params, input_ids, batch.get("attention_mask"))
+            targets = input_ids[:, 1:]
+            logits = logits[:, :-1].astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            if "attention_mask" in batch:
+                w = batch["attention_mask"][:, 1:].astype(jnp.float32)
+                return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+            return nll.mean()
+
+        return fn

@@ -1,0 +1,70 @@
+"""Benchmark: BERT-base GLUE-MRPC-shaped training throughput (steps/sec/chip).
+
+Matches BASELINE.json target metric #1 (`nlp_example.py` — bert-base, batch 32,
+seq 128, AdamW, bf16 compute). The reference publishes no training-throughput
+number (`published: {}` in BASELINE.json), so ``vs_baseline`` is null.
+
+Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import Bert
+
+    accelerator = Accelerator(mixed_precision="bf16")
+    model = Bert("bert-base")
+    prepared = accelerator.prepare_model(model)
+    accelerator.prepare_optimizer(optax.adamw(2e-5))
+    step = accelerator.compiled_step(Bert.loss_fn(model))
+
+    batch_size, seq_len = 32, 128
+    rng = np.random.default_rng(0)
+    sharding = accelerator.state.data_sharding()
+    batch = {
+        "input_ids": jax.device_put(jnp.asarray(rng.integers(0, 30522, (batch_size, seq_len)), jnp.int32), sharding),
+        "attention_mask": jax.device_put(jnp.ones((batch_size, seq_len), jnp.int32), sharding),
+        "token_type_ids": jax.device_put(jnp.zeros((batch_size, seq_len), jnp.int32), sharding),
+        "labels": jax.device_put(jnp.asarray(rng.integers(0, 2, (batch_size,)), jnp.int32), sharding),
+    }
+
+    # warmup (compile + settle the async pipeline); float() forces a real
+    # device->host value, which is the only reliable fence on every platform
+    for _ in range(5):
+        loss = step(batch)
+    float(loss)
+
+    n_steps = 20
+    start = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(batch)
+    float(loss)  # donation chains every step; fetching the last syncs them all
+    elapsed = time.perf_counter() - start
+
+    n_chips = jax.device_count()
+    steps_per_sec_per_chip = n_steps / elapsed / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "bert-base MRPC-shaped train steps/sec/chip (bs=32, seq=128, bf16, adamw)",
+                "value": round(steps_per_sec_per_chip, 4),
+                "unit": "steps/sec/chip",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
