@@ -1,0 +1,370 @@
+"""Big-model inference: abstract init → device map → streamed execution.
+
+Parity: reference big_modeling.py + hooks.py (§2.5 of SURVEY):
+- init_empty_weights (big_modeling.py:56) → ``jax.eval_shape`` abstract init:
+  zero bytes allocated, exact shapes/dtypes.
+- infer_auto_device_map + dispatch_model (305) + AlignDevicesHook (hooks.py:
+  212) → ``dispatch_model`` here returns a ``StreamedCausalLM`` that keeps
+  resident components on the TPU and streams cpu/disk layers through HBM with
+  an async double buffer. No forward-patching: streaming is explicit in the
+  run loop, and the per-layer compute is ONE jit program reused by every
+  layer (static shapes — the XLA analogue of the hook's device juggling).
+- cpu_offload / disk_offload (169/249) → thin wrappers over dispatch_model.
+- load_checkpoint_and_dispatch (498) → same pipeline from a weights file.
+
+Transfer design: each offloaded layer is *packed into one contiguous host
+buffer* at dispatch time, so streaming a layer is a single DMA (the reference
+moves every tensor separately through AlignDevicesHook — hooks.py:328-358);
+unpacking into the nine weight views happens on-device inside the jitted
+layer program, where slicing is HBM-bandwidth cheap.
+
+Memory invariant (benchmarks/README.md:44-46): device HBM holds the resident
+components + at most two streamed layer buffers; host RAM holds only the
+offloaded components (memmap-backed when from disk).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .logging import get_logger
+from .models.attention import rotary_embedding
+from .models.config import TransformerConfig
+from .models.llama import Llama, decoder_layer, rms_norm
+from .utils.modeling import check_device_map, infer_auto_device_map
+from .utils.offload import load_offloaded_weight, offload_weight, save_offload_index
+
+logger = get_logger(__name__)
+
+LAYER_KEYS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate", "w_up", "w_down")
+
+
+def init_empty_weights(model) -> Any:
+    """Abstract parameters: shapes/dtypes with zero allocation.
+
+    The reference monkey-patches nn.Module registration onto the meta device
+    (big_modeling.py:121-166); functional init makes this a one-liner.
+    """
+    return jax.eval_shape(model.init, jax.random.key(0))
+
+
+init_on_device = init_empty_weights  # parity alias
+
+
+class LayerPacker:
+    """Fixed layout of one decoder layer in a single contiguous buffer."""
+
+    def __init__(self, cfg: TransformerConfig, dtype):
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+        self.dtype = dtype
+        self.shapes = {
+            "attn_norm": (h,),
+            "wq": (h, nh * d),
+            "wk": (h, nkv * d),
+            "wv": (h, nkv * d),
+            "wo": (nh * d, h),
+            "mlp_norm": (h,),
+            "w_gate": (h, i),
+            "w_up": (h, i),
+            "w_down": (i, h),
+        }
+        self.offsets = {}
+        offset = 0
+        for key in LAYER_KEYS:
+            size = int(np.prod(self.shapes[key]))
+            self.offsets[key] = (offset, size)
+            offset += size
+        self.total = offset
+
+    def pack(self, layer: Mapping[str, Any]) -> np.ndarray:
+        np_dtype = np.asarray(jnp.zeros((), self.dtype)).dtype
+        buf = np.empty((self.total,), np_dtype)
+        for key in LAYER_KEYS:
+            offset, size = self.offsets[key]
+            buf[offset : offset + size] = np.asarray(layer[key], np_dtype).ravel()
+        return buf
+
+    def unpack(self, buf: jax.Array) -> dict[str, jax.Array]:
+        """On-device view extraction (static slices; used inside jit)."""
+        out = {}
+        for key in LAYER_KEYS:
+            offset, size = self.offsets[key]
+            out[key] = buf[offset : offset + size].reshape(self.shapes[key])
+        return out
+
+
+class StreamedCausalLM:
+    """A llama-family model whose layers may live on device, host RAM, or disk.
+
+    ``__call__`` and ``generate`` stream non-resident layers through the
+    device with an async double buffer (device_put of layer i+1 is issued
+    before layer i's compute is awaited — the H2D copy rides DMA while the
+    MXU works).
+    """
+
+    def __init__(
+        self,
+        model: Llama,
+        resident: dict[str, jax.Array],
+        layer_buffers: list[Any],  # packed 1D host buffers (np/memmap) or device arrays
+        layer_on_device: list[bool],
+        packer: LayerPacker,
+        dtype=jnp.bfloat16,
+    ):
+        self.model = model
+        self.config: TransformerConfig = model.config
+        self.resident = resident
+        self.layer_buffers = layer_buffers
+        self.layer_on_device = layer_on_device
+        self.packer = packer
+        self.dtype = dtype
+        self.hf_device_map: dict[str, str] = {}
+        self._layer_fn = None
+        self._cached_layer_fn = None
+
+    def _put(self, buf) -> jax.Array:
+        return jax.device_put(jnp.asarray(buf))  # single contiguous DMA
+
+    def _resident(self, key: str) -> jax.Array:
+        """Fetch a non-layer component, streaming it if device_map kept it on
+        host/disk (embed/head can legitimately spill on tight budgets)."""
+        value = self.resident[key]
+        if isinstance(value, jax.Array):
+            return value
+        return self._put(np.asarray(value))
+
+    def _iter_device_layers(self):
+        """Yield each layer's packed device buffer, double-buffering transfers."""
+        L = len(self.layer_buffers)
+        next_buf = None
+        for i in range(L):
+            if self.layer_on_device[i]:
+                current = self.layer_buffers[i]
+            else:
+                current = next_buf if next_buf is not None else self._put(self.layer_buffers[i])
+            next_buf = None
+            j = i + 1
+            if j < L and not self.layer_on_device[j]:
+                next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
+            yield current
+
+    def _get_layer_fn(self):
+        if self._layer_fn is None:
+            cfg = self.config
+            unpack = self.packer.unpack
+
+            @jax.jit
+            def layer_fn(h, buf, cos, sin, mask):
+                h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True)
+                return h
+
+            self._layer_fn = layer_fn
+        return self._layer_fn
+
+    def __call__(self, input_ids, attention_mask: Optional[Any] = None) -> jax.Array:
+        """Full-sequence logits [B, S, V]."""
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        h = jnp.take(self._resident("embed_tokens"), input_ids, axis=0).astype(self.dtype)
+        positions = jnp.arange(s)[None, :]
+        cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+        mask = None
+        if attention_mask is not None:
+            mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
+        layer_fn = self._get_layer_fn()
+        for buf in self._iter_device_layers():
+            h = layer_fn(h, buf, cos, sin, mask)
+        h = rms_norm(h, self._resident("final_norm"), cfg.norm_eps)
+        head = (
+            self._resident("embed_tokens").T
+            if cfg.tie_embeddings
+            else self._resident("lm_head")
+        )
+        return (h @ head.astype(h.dtype)).astype(jnp.float32)
+
+    def _get_cached_layer_fn(self):
+        if self._cached_layer_fn is None:
+            cfg = self.config
+            unpack = self.packer.unpack
+
+            @jax.jit
+            def fn(h, buf, cache, length, cos, sin, mask):
+                h, new_cache = decoder_layer(
+                    cfg, h, unpack(buf), cos, sin, mask,
+                    cache={"k": cache["k"], "v": cache["v"], "length": length},
+                )
+                return h, {"k": new_cache["k"], "v": new_cache["v"]}
+
+            self._cached_layer_fn = fn
+        return self._cached_layer_fn
+
+    def generate(self, input_ids, max_new_tokens: int = 20, temperature: float = 0.0, rng=None) -> np.ndarray:
+        """Greedy/sampled decode; each token streams the offloaded layers once
+        (the reference's per-token cost model, benchmarks/README.md:39-42)."""
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        max_len = s + max_new_tokens
+        caches = [
+            {
+                "k": jnp.zeros((b, max_len, cfg.kv_heads, cfg.dim_per_head), self.dtype),
+                "v": jnp.zeros((b, max_len, cfg.kv_heads, cfg.dim_per_head), self.dtype),
+            }
+            for _ in range(cfg.num_layers)
+        ]
+        if rng is None:
+            rng = jax.random.key(0)
+
+        cached_layer_fn = self._get_cached_layer_fn()
+        tokens = [input_ids]
+        current = input_ids
+        length = 0
+        # max_new_tokens forwards total: prefill samples token 1, then one
+        # decode forward per remaining token (no discarded final pass).
+        for _ in range(max_new_tokens):
+            blk = current.shape[1]
+            h = jnp.take(self._resident("embed_tokens"), current, axis=0).astype(self.dtype)
+            positions = length + jnp.arange(blk)[None, :]
+            cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+            q_pos = length + jnp.arange(blk)
+            mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
+            for i, buf in enumerate(self._iter_device_layers()):
+                h, caches[i] = cached_layer_fn(h, buf, caches[i], jnp.int32(length), cos, sin, mask)
+            h = rms_norm(h, self._resident("final_norm"), cfg.norm_eps)
+            head = (
+                self._resident("embed_tokens").T
+                if cfg.tie_embeddings
+                else self._resident("lm_head")
+            )
+            logits = (h[:, -1] @ head.astype(h.dtype)).astype(jnp.float32)
+            length += blk
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(sub, logits / temperature, axis=-1).astype(jnp.int32)
+            current = nxt[:, None]
+            tokens.append(current)
+        return np.concatenate([np.asarray(t) for t in tokens], axis=1)
+
+
+def dispatch_model(
+    model: Llama,
+    params: Any,
+    device_map: dict[str, str] | str = "auto",
+    max_memory: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+    dtype=jnp.bfloat16,
+) -> StreamedCausalLM:
+    """Place components per ``device_map`` and return the streaming executor.
+
+    Parity: reference dispatch_model (big_modeling.py:305) + hook attachment.
+    """
+    cfg = model.config
+    dtype_bytes = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
+    if isinstance(device_map, str):
+        device_map = infer_auto_device_map(model, max_memory=max_memory, dtype_bytes=dtype_bytes)
+    check_device_map(model, device_map)
+
+    resident: dict[str, Any] = {}
+    np_dtype = np.asarray(jnp.zeros((), dtype)).dtype
+    for key in ("embed_tokens", "final_norm", "lm_head"):
+        if key in params:
+            target = device_map.get(key, "device")
+            host = np.asarray(params[key], np_dtype)
+            if target == "device":
+                resident[key] = jax.device_put(jnp.asarray(host))
+            elif target == "cpu":
+                resident[key] = host
+            elif target == "disk":
+                if offload_dir is None:
+                    raise ValueError(f"device_map places {key} on disk — pass offload_dir")
+                os.makedirs(offload_dir, exist_ok=True)
+                disk_meta = offload_weight(host, key, offload_dir, {})
+                resident[key] = load_offloaded_weight(
+                    os.path.join(offload_dir, f"{key}.dat"), disk_meta[key]
+                )
+            else:
+                raise ValueError(f"Unknown target {target!r} for {key}")
+
+    packer = LayerPacker(cfg, dtype)
+    stacked = {k: np.asarray(v) for k, v in params["layers"].items()}
+    layer_buffers: list[Any] = []
+    layer_on_device: list[bool] = []
+    disk_index: dict = {}
+    for i in range(cfg.num_layers):
+        layer = {k: stacked[k][i] for k in LAYER_KEYS}
+        target = device_map.get(f"layers.{i}", "device")
+        packed = packer.pack(layer)
+        if target == "device":
+            layer_buffers.append(jax.device_put(jnp.asarray(packed)))
+            layer_on_device.append(True)
+        elif target == "cpu":
+            layer_buffers.append(packed)
+            layer_on_device.append(False)
+        elif target == "disk":
+            if offload_dir is None:
+                raise ValueError("device_map places layers on disk — pass offload_dir")
+            os.makedirs(offload_dir, exist_ok=True)
+            name = f"layers.{i}.packed"
+            disk_index = offload_weight(packed, name, offload_dir, disk_index)
+            layer_buffers.append(
+                load_offloaded_weight(os.path.join(offload_dir, f"{name}.dat"), disk_index[name])
+            )
+            layer_on_device.append(False)
+        else:
+            raise ValueError(f"Unknown target {target!r} for layers.{i}")
+    if disk_index:
+        save_offload_index(disk_index, offload_dir)
+
+    dispatched = StreamedCausalLM(model, resident, layer_buffers, layer_on_device, packer, dtype=dtype)
+    dispatched.hf_device_map = dict(device_map)
+    return dispatched
+
+
+def cpu_offload(model: Llama, params: Any, dtype=jnp.bfloat16) -> StreamedCausalLM:
+    """Everything streamed from host RAM (reference big_modeling.py:169)."""
+    cfg = model.config
+    device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    device_map.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
+    return dispatch_model(model, params, device_map, dtype=dtype)
+
+
+def disk_offload(model: Llama, params: Any, offload_dir: str, dtype=jnp.bfloat16) -> StreamedCausalLM:
+    """Everything streamed from disk memmaps (reference big_modeling.py:249)."""
+    cfg = model.config
+    device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    device_map.update({f"layers.{i}": "disk" for i in range(cfg.num_layers)})
+    return dispatch_model(model, params, device_map, offload_dir=offload_dir, dtype=dtype)
+
+
+def load_checkpoint_and_dispatch(
+    model: Llama,
+    checkpoint: str,
+    device_map: dict[str, str] | str = "auto",
+    max_memory: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+    dtype=jnp.bfloat16,
+) -> StreamedCausalLM:
+    """Load weights (file/dir/shard-index) and dispatch (big_modeling.py:498)."""
+    from .checkpointing import load_model_weights
+
+    flat = load_model_weights(checkpoint)
+    # rebuild the nested structure the dispatcher expects
+    params: dict[str, Any] = {"layers": {}}
+    for key, value in flat.items():
+        if key.startswith("layers/"):
+            params["layers"][key.split("/", 1)[1]] = value
+        else:
+            params[key] = value
+    return dispatch_model(
+        model, params, device_map=device_map, max_memory=max_memory, offload_dir=offload_dir, dtype=dtype
+    )

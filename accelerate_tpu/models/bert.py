@@ -36,6 +36,11 @@ class Bert:
         assert self.config.arch == "bert"
 
     def init(self, rng: jax.Array) -> dict:
+        if not hasattr(self, "_init_jit"):
+            self._init_jit = jax.jit(self._init)
+        return self._init_jit(rng)
+
+    def _init(self, rng: jax.Array) -> dict:
         cfg = self.config
         h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
         keys = iter(jax.random.split(rng, 20))

@@ -1,0 +1,134 @@
+"""Autoregressive generation with a static KV cache.
+
+TPU-first: the decode step is one jit program with *static shapes* — the cache
+is pre-allocated at ``max_len`` and written via ``dynamic_update_slice``, so
+XLA compiles exactly two programs (prefill, decode) per (model, shape), cached
+on the model instance and reused across ``generate`` calls. The per-token path
+is what the reference's big-model-inference benchmark measures
+(benchmarks/big_model_inference.py per-token seconds).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .attention import rotary_embedding
+from .config import TransformerConfig
+from .llama import Llama, decoder_layer, rms_norm
+
+
+def init_cache(config: TransformerConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+    """Pre-allocated KV cache: stacked [L, B, T, KV, D] for the layer scan."""
+    L, kv, d = config.num_layers, config.kv_heads, config.dim_per_head
+    return {
+        "k": jnp.zeros((L, batch, max_len, kv, d), dtype),
+        "v": jnp.zeros((L, batch, max_len, kv, d), dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: dict):
+    """Run ``input_ids`` (prefill block or single token) against the cache.
+
+    Returns (logits for the LAST position [B, V], updated cache).
+    """
+    cfg = model.config
+    b, s = input_ids.shape
+    length = cache["length"]
+    h = jnp.take(params["embed_tokens"], input_ids, axis=0)
+    positions = length + jnp.arange(s)[None, :]
+    cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+
+    # positions <= current are attendable: causal within the block, full over cache
+    t = cache["k"].shape[2]
+    query_pos = length + jnp.arange(s)
+    key_pos = jnp.arange(t)
+    mask = (key_pos[None, :] <= query_pos[:, None])[None, None]  # [1,1,S,T]
+
+    def body(carry, xs):
+        h = carry
+        lp, k_cache, v_cache = xs
+        h, new_cache = decoder_layer(
+            cfg, h, lp, cos, sin, mask,
+            cache={"k": k_cache, "v": v_cache, "length": length},
+        )
+        return h, (new_cache["k"], new_cache["v"])
+
+    h, (k_cache, v_cache) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = h[:, -1] @ head.astype(h.dtype)
+    new_cache = {"k": k_cache, "v": v_cache, "length": length + s}
+    return logits.astype(jnp.float32), new_cache
+
+
+def _jit_for(model: Llama, name: str, build):
+    """Per-model jit cache so repeated generate() calls reuse compilations."""
+    cache = getattr(model, "_jit_cache", None)
+    if cache is None:
+        cache = {}
+        model._jit_cache = cache
+    if name not in cache:
+        cache[name] = build()
+    return cache[name]
+
+
+def generate(
+    model: Llama,
+    params: dict,
+    input_ids,  # [B, S] prompt
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+) -> np.ndarray:
+    """Greedy (temperature=0) or sampled generation. Returns [B, S+new] ids."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s = input_ids.shape
+    max_len = s + max_new_tokens
+    cache = init_cache(model.config, b, max_len, dtype=params["embed_tokens"].dtype)
+
+    prefill = _jit_for(model, "prefill", lambda: jax.jit(lambda p, ids, c: forward_with_cache(model, p, ids, c)))
+    logits, cache = prefill(params, input_ids, cache)
+
+    greedy = temperature <= 0.0
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    if rng is None:
+        rng = jax.random.key(0)
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample(logits, keys[0])
+
+    def decode_loop(params, cache, first, keys):
+        def step(carry, key):
+            cache, token = carry
+            logits, cache = forward_with_cache(model, params, token[:, None], cache)
+            nxt = sample(logits, key)
+            return (cache, nxt), nxt
+
+        return jax.lax.scan(step, (cache, first), keys)
+
+    if max_new_tokens > 1:
+        # temperature is baked into the traced program — key the cache on it
+        decode = _jit_for(model, f"decode_g{greedy}_t{temperature}", lambda: jax.jit(decode_loop))
+        (_, _), rest = decode(params, cache, first, keys[1:])
+        tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+    else:
+        tokens = first[:, None]
+    out = np.concatenate([np.asarray(input_ids), np.asarray(tokens)], axis=1)
+    if eos_token_id is not None:
+        # truncate after first EOS per row (host-side cosmetic)
+        for row in range(b):
+            hits = np.where(out[row, s:] == eos_token_id)[0]
+            if hits.size:
+                out[row, s + hits[0] + 1 :] = eos_token_id
+    return out

@@ -45,6 +45,52 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x * weight.astype(jnp.float32)).astype(dtype)
 
 
+def decoder_layer(
+    cfg: TransformerConfig,
+    h: jax.Array,  # [B, S, H]
+    lp: dict,  # one layer's params
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: Optional[jax.Array],
+    causal: bool = True,
+    cache: Optional[dict] = None,  # {"k","v"} [B, T, KV, D] + write offset "length"
+    dropout_rngs: tuple = (None, None),
+    dropout_rate: float = 0.0,
+):
+    """The one llama decoder layer used by every execution path (training
+    scan, KV-cache decode, streamed big-model inference). Returns
+    (h, updated_cache_or_None)."""
+    from .attention import dropout  # local import to avoid cycle at module load
+
+    b, s = h.shape[:2]
+    nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    q = (x @ lp["wq"]).reshape(b, s, nh, d)
+    k = (x @ lp["wk"]).reshape(b, s, nkv, d)
+    v = (x @ lp["wv"]).reshape(b, s, nkv, d)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    new_cache = None
+    if cache is not None:
+        k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, cache["length"], 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cache["length"], 0, 0))
+        attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)
+        new_cache = {"k": k_cache, "v": v_cache, "length": cache["length"]}
+    else:
+        attn = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    attn_out = attn.reshape(b, s, nh * d) @ lp["wo"]
+    if dropout_rngs[0] is not None:
+        attn_out = dropout(attn_out, dropout_rate, dropout_rngs[0])
+    h = h + attn_out
+    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+    mlp_out = gated @ lp["w_down"]
+    if dropout_rngs[1] is not None:
+        mlp_out = dropout(mlp_out, dropout_rate, dropout_rngs[1])
+    h = h + mlp_out
+    return h, new_cache
+
+
 class Llama:
     """(init, apply) pair for a llama-style causal LM."""
 
@@ -55,6 +101,14 @@ class Llama:
     # -- parameters --------------------------------------------------------
 
     def init(self, rng: jax.Array) -> dict:
+        # One compiled program instead of ~10 per-tensor RNG dispatches — on
+        # remote-attached TPUs each dispatch is a round trip. The jit wrapper
+        # is cached on the instance so repeated init() reuses the compile.
+        if not hasattr(self, "_init_jit"):
+            self._init_jit = jax.jit(self._init)
+        return self._init_jit(rng)
+
+    def _init(self, rng: jax.Array) -> dict:
         cfg = self.config
         h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
         d, nh, nkv, L = cfg.dim_per_head, cfg.num_heads, cfg.kv_heads, cfg.num_layers
@@ -128,24 +182,11 @@ class Llama:
 
         def layer(h, xs):
             lp = xs[0] if use_dropout else xs
-            rngs = xs[1] if use_dropout else (None, None)
-            x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-            q = (x @ lp["wq"]).reshape(b, s, nh, d)
-            k = (x @ lp["wk"]).reshape(b, s, nkv, d)
-            v = (x @ lp["wv"]).reshape(b, s, nkv, d)
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
-            attn = dot_product_attention(q, k, v, mask=mask, causal=True)
-            attn_out = attn.reshape(b, s, nh * d) @ lp["wo"]
-            if use_dropout:
-                attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
-            h = h + attn_out
-            x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-            gated = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
-            mlp_out = gated @ lp["w_down"]
-            if use_dropout:
-                mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
-            h = h + mlp_out
+            rngs = tuple(xs[1]) if use_dropout else (None, None)
+            h, _ = decoder_layer(
+                cfg, h, lp, cos, sin, mask, causal=True,
+                dropout_rngs=rngs, dropout_rate=cfg.dropout_rate,
+            )
             h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
             return h, None
 

@@ -1,0 +1,114 @@
+"""Disk-offload weight store: numpy memmaps + JSON index.
+
+Parity: reference utils/offload.py — offload_weight/load_offloaded_weight
+(25-65), offload_state_dict (85), save_offload_index (68),
+OffloadedWeightsLoader (127), PrefixedDataset (104). bf16 is handled natively
+via ml_dtypes (the reference needed an int16 reinterpret trick for torch
+tensors, offload.py:28-31).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Any, Optional
+
+import numpy as np
+
+import ml_dtypes
+
+_DTYPES = {
+    "bfloat16": ml_dtypes.bfloat16,
+    "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
+    "float8_e5m2": ml_dtypes.float8_e5m2,
+}
+
+
+def _np_dtype(name: str):
+    return _DTYPES.get(name, np.dtype(name))
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """Write one tensor as a raw memmap file; record it in ``index``."""
+    weight = np.asarray(weight)
+    dtype_name = weight.dtype.name
+    array_path = os.path.join(offload_folder, f"{weight_name}.dat")
+    if index is not None:
+        index[weight_name] = {"dtype": dtype_name, "shape": list(weight.shape)}
+    if weight.ndim == 0:
+        weight = weight[None]
+    file_array = np.memmap(array_path, dtype=weight.dtype, mode="w+", shape=weight.shape)
+    file_array[:] = weight[:]
+    file_array.flush()
+    return index if index is not None else {}
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    shape = tuple(weight_info["shape"])
+    if len(shape) == 0:
+        shape = (1,)
+    dtype = _np_dtype(weight_info["dtype"])
+    array = np.memmap(weight_file, dtype=dtype, mode="r", shape=shape)
+    if len(weight_info["shape"]) == 0:
+        array = array[0]
+    return array
+
+
+def save_offload_index(index: dict, offload_folder: str) -> None:
+    with open(os.path.join(offload_folder, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping[str, Any]) -> None:
+    """Offload a whole flat dict to ``save_dir`` (reference offload.py:85)."""
+    os.makedirs(save_dir, exist_ok=True)
+    index: dict = {}
+    for name, value in state_dict.items():
+        index = offload_weight(value, name, save_dir, index)
+    save_offload_index(index, save_dir)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy mapping over in-RAM tensors + on-disk memmaps (offload.py:127)."""
+
+    def __init__(self, state_dict: Optional[dict] = None, save_folder: Optional[str] = None, index: Optional[dict] = None):
+        if state_dict is None and save_folder is None:
+            raise ValueError("Need either state_dict or save_folder")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        if index is None and save_folder is not None:
+            with open(os.path.join(save_folder, "index.json")) as f:
+                index = json.load(f)
+        self.index = dict(index or {})
+        self.all_keys = list(self.state_dict) + [k for k in self.index if k not in self.state_dict]
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        weight_info = self.index[key]
+        weight_file = os.path.join(self.save_folder, f"{key}.dat")
+        return load_offloaded_weight(weight_file, weight_info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)
+
+
+class PrefixedDataset(Mapping):
+    """View of a mapping under a key prefix (reference offload.py:104)."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter(k[len(self.prefix) :] for k in self.dataset if k.startswith(self.prefix))
+
+    def __len__(self):
+        return len([k for k in self.dataset if k.startswith(self.prefix)])
