@@ -335,6 +335,14 @@ class Accelerator:
         shardings = infer_shardings(params, self.mesh, rules)
         if device_placement if device_placement is not None else self.device_placement:
             params = shard_tree(params, shardings)
+        from .utils.constants import MESH_AXIS_SEQUENCE
+
+        if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1 and hasattr(model, "attention_fn"):
+            # sequence axis active: swap in exact ring attention so K/V blocks
+            # rotate over ICI instead of being all-gathered
+            from .parallel.ring_attention import make_ring_attention
+
+            model.attention_fn = make_ring_attention(self.mesh)
         prepared = PreparedModel(model, ParamBox(params), shardings, self.state.precision_policy)
         self._models.append(prepared)
         return prepared

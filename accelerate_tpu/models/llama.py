@@ -56,6 +56,8 @@ def decoder_layer(
     cache: Optional[dict] = None,  # {"k","v"} [B, T, KV, D] + write offset "length"
     dropout_rngs: tuple = (None, None),
     dropout_rate: float = 0.0,
+    attention_fn=None,  # e.g. ring attention for sequence-sharded activations
+    kv_mask=None,  # raw [B, S] validity mask for attention_fn implementations
 ):
     """The one llama decoder layer used by every execution path (training
     scan, KV-cache decode, streamed big-model inference). Returns
@@ -76,6 +78,8 @@ def decoder_layer(
         v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cache["length"], 0, 0))
         attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)
         new_cache = {"k": k_cache, "v": v_cache, "length": cache["length"]}
+    elif attention_fn is not None:
+        attn = attention_fn(q, k, v, kv_mask)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=causal)
     attn_out = attn.reshape(b, s, nh * d) @ lp["wo"]
@@ -97,6 +101,9 @@ class Llama:
     def __init__(self, config: TransformerConfig | str):
         self.config = get_config(config) if isinstance(config, str) else config
         assert self.config.arch == "llama"
+        # Swapped in by Accelerator.prepare_model when the mesh has a sequence
+        # axis (ring attention) or a custom kernel is configured.
+        self.attention_fn = None
 
     # -- parameters --------------------------------------------------------
 
@@ -186,6 +193,7 @@ class Llama:
             h, _ = decoder_layer(
                 cfg, h, lp, cos, sin, mask, causal=True,
                 dropout_rngs=rngs, dropout_rate=cfg.dropout_rate,
+                attention_fn=self.attention_fn, kv_mask=attention_mask,
             )
             h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
             return h, None
