@@ -335,7 +335,7 @@ class Accelerator:
         shardings = infer_shardings(params, self.mesh, rules)
         if device_placement if device_placement is not None else self.device_placement:
             params = shard_tree(params, shardings)
-        from .utils.constants import MESH_AXIS_SEQUENCE
+        from .utils.constants import MESH_AXIS_PIPELINE, MESH_AXIS_SEQUENCE
 
         if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1 and hasattr(model, "attention_fn"):
             # sequence axis active: swap in exact ring attention so K/V blocks
@@ -343,6 +343,15 @@ class Accelerator:
             from .parallel.ring_attention import make_ring_attention
 
             model.attention_fn = make_ring_attention(self.mesh)
+        if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1 and hasattr(model, "pipeline_fn"):
+            from .parallel.pipeline import make_pipeline_layers_fn
+
+            num_micro = (
+                self.model_parallel_plugin.num_microbatches
+                if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
+                else self.mesh.shape[MESH_AXIS_PIPELINE]
+            )
+            model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
         prepared = PreparedModel(model, ParamBox(params), shardings, self.state.precision_policy)
         self._models.append(prepared)
         return prepared

@@ -102,8 +102,9 @@ class Llama:
         self.config = get_config(config) if isinstance(config, str) else config
         assert self.config.arch == "llama"
         # Swapped in by Accelerator.prepare_model when the mesh has a sequence
-        # axis (ring attention) or a custom kernel is configured.
+        # axis (ring attention) or a pipeline axis (GPipe layer schedule).
         self.attention_fn = None
+        self.pipeline_fn = None
 
     # -- parameters --------------------------------------------------------
 
@@ -146,14 +147,18 @@ class Llama:
         """Megatron-style TP: attention split by heads, MLP by intermediate;
         row-parallel projections bring activations back (GSPMD inserts the
         reduce). Leading dim of stacked layers is never sharded (scan axis)."""
+        from ..utils.constants import MESH_AXIS_PIPELINE
+
         t = MESH_AXIS_TENSOR
+        p = MESH_AXIS_PIPELINE  # stacked-layer leading dim; size-1 axis = no-op
         return [
             (r"embed_tokens", (t, None)),          # vocab-parallel embedding
-            (r"layers/(wq|wk|wv)", (None, None, t)),  # column-parallel
-            (r"layers/wo", (None, t, None)),          # row-parallel
-            (r"layers/(w_gate|w_up)", (None, None, t)),
-            (r"layers/w_down", (None, t, None)),
-            (r"(attn_norm|mlp_norm|final_norm)", (None,)),
+            (r"layers/(wq|wk|wv)", (p, None, t)),  # column-parallel
+            (r"layers/wo", (p, t, None)),          # row-parallel
+            (r"layers/(w_gate|w_up)", (p, None, t)),
+            (r"layers/w_down", (p, t, None)),
+            (r"layers/(attn_norm|mlp_norm)", (p, None)),
+            (r"final_norm", (None,)),
             (r"lm_head", (None, t)),
         ]
 
@@ -198,8 +203,13 @@ class Llama:
             h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
             return h, None
 
-        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-        h, _ = jax.lax.scan(layer, h, xs)
+        if self.pipeline_fn is not None:
+            if use_dropout:
+                raise NotImplementedError("dropout inside the pipeline schedule is not supported yet")
+            h = self.pipeline_fn(params["layers"], h, cos, sin, mask)
+        else:
+            xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+            h, _ = jax.lax.scan(layer, h, xs)
         h = rms_norm(h, params["final_norm"], cfg.norm_eps)
         head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
         logits = h @ head.astype(h.dtype)

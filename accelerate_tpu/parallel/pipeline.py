@@ -1,0 +1,140 @@
+"""Pipeline parallelism: layers sharded over the ``pipeline`` mesh axis.
+
+Parity-plus (SURVEY §2.6 PP row): the reference offers training PP only by
+delegating to Megatron-LM and inference PP via pippy's fx tracing
+(inference.py:126). Here PP is native: the stacked layer parameters are
+sharded on their leading (layer) dimension over the ``pipeline`` axis, and a
+GPipe schedule runs *inside one jit program* via ``shard_map``:
+
+- the shard_map is manual over ONLY the ``pipeline`` axis (``axis_names``):
+  tensor/fsdp/data stay in GSPMD auto mode, so Megatron-style TP matmuls and
+  ZeRO-3 parameter sharding keep working *inside* each pipeline stage;
+- every stage holds L/P layers; activations (and each microbatch's attention
+  mask) hop stage→stage with ``ppermute`` over neighbor ICI links;
+- the microbatch loop is a ``lax.scan`` over M + P - 1 ticks — stage p works
+  on microbatch t-p at tick t, filling and draining like 1F1B's forward pass;
+- backward is jax.grad through the scan: XLA reverses the ppermutes into the
+  backward pipeline automatically (no hand-written schedule);
+- each stage's compute is wrapped in ``jax.checkpoint`` so only per-tick
+  boundary activations stay live.
+
+Bubble fraction is (P-1)/(M+P-1) — pick num_microbatches >= 4*P for ~<20%
+overhead, as with any GPipe-family schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.constants import MESH_AXIS_PIPELINE, MESH_AXIS_SEQUENCE
+
+
+def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
+    """Build ``fn(stacked_layer_params, h, cos, sin, mask) -> h`` running the
+    decoder stack as a pipeline over the ``pipeline`` mesh axis.
+
+    Constraints (v1): the ``sequence`` axis must be 1 (ring attention inside a
+    pipeline stage is a follow-up); global batch must be a multiple of
+    ``num_microbatches``; layer count must divide the pipeline size; cos/sin
+    must be batch-invariant (default integer positions).
+    """
+    from ..models.llama import decoder_layer
+
+    if mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
+        raise NotImplementedError("pipeline + sequence axes combined is not supported yet")
+    nstages = mesh.shape[MESH_AXIS_PIPELINE]
+    if cfg.num_layers % nstages != 0:
+        raise ValueError(f"num_layers={cfg.num_layers} must divide pipeline size {nstages}")
+    M = num_microbatches
+
+    def local_fn(layers, h, cos, sin, mask):
+        # manual over pipeline only: h/cos/sin/mask are GLOBAL here (their
+        # data/tensor shardings are still handled by GSPMD in auto mode)
+        idx = jax.lax.axis_index(MESH_AXIS_PIPELINE)
+
+        def stage(h_mb, mask_mb):
+            def body(hh, lp):
+                hh, _ = decoder_layer(cfg, hh, lp, cos, sin, mask_mb, causal=True)
+                return hh, None
+
+            out, _ = jax.lax.scan(body, h_mb, layers)
+            return out
+
+        stage = jax.checkpoint(stage)
+
+        b = h.shape[0]
+        if b % M != 0:
+            raise ValueError(
+                f"num_microbatches={M} must divide the batch size {b} "
+                "(raise the batch or lower num_microbatches)"
+            )
+        mb = h.reshape(M, b // M, *h.shape[1:])
+        if mask is None:
+            mask_mb_all = jnp.ones((M, b // M, 1, 1, h.shape[1]), bool)
+        else:
+            mask_mb_all = mask.reshape(M, b // M, *mask.shape[1:])
+        state = jnp.zeros_like(mb[0])
+        state_mask = jnp.ones_like(mask_mb_all[0])
+        outputs = jnp.zeros_like(mb)
+        # the loop makes these pipeline-varying (stage-dependent values); the
+        # initial carry must already carry that type for scan to typecheck
+        have = set(getattr(h.aval, "vma", ()) or ())
+        missing = tuple({MESH_AXIS_PIPELINE} - have)
+        if missing:
+            state = jax.lax.pcast(state, missing, to="varying")
+            state_mask = jax.lax.pcast(state_mask, missing, to="varying")
+            outputs = jax.lax.pcast(outputs, missing, to="varying")
+        fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
+
+        def tick(carry, t):
+            state, state_mask, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), keepdims=False)
+            inject_mask = jax.lax.dynamic_index_in_dim(
+                mask_mb_all, jnp.clip(t, 0, M - 1), keepdims=False
+            )
+            x = jnp.where(idx == 0, inject, state)
+            m = jnp.where(idx == 0, inject_mask, state_mask)
+            y = stage(x, m)
+            out_t = t - (nstages - 1)
+            collected = jax.lax.dynamic_update_slice(
+                outputs, y[None].astype(outputs.dtype), (jnp.clip(out_t, 0, M - 1),) + (0,) * y.ndim
+            )
+            valid = (out_t >= 0) & (idx == nstages - 1)
+            outputs = jnp.where(valid, collected, outputs)
+            if nstages > 1:
+                # the mask travels with its activation through the pipeline
+                state = jax.lax.ppermute(y, MESH_AXIS_PIPELINE, fwd_perm)
+                state_mask = jax.lax.ppermute(m, MESH_AXIS_PIPELINE, fwd_perm)
+            else:
+                state, state_mask = y, m
+            return (state, state_mask, outputs), None
+
+        ticks = jnp.arange(M + nstages - 1)
+        (_, _, outputs), _ = jax.lax.scan(tick, (state, state_mask, outputs), ticks)
+        # fan the last stage's collected outputs out to every stage
+        outputs = jnp.where(idx == nstages - 1, outputs, jnp.zeros_like(outputs))
+        outputs = jax.lax.psum(outputs, MESH_AXIS_PIPELINE)
+        return outputs.reshape(h.shape)
+
+    def fn(stacked_layers, h, cos, sin, mask):
+        if cos.shape[0] != 1:
+            raise NotImplementedError("per-row positions are not supported in the pipeline schedule")
+        # only the pipeline placement is manual; every other dim/axis is left
+        # to GSPMD (tensor/fsdp shardings keep working inside the stage)
+        layers_specs = jax.tree.map(lambda _: P(MESH_AXIS_PIPELINE), stacked_layers)
+        other_specs = (P(), P(), P()) if mask is None else (P(), P(), P(), P())
+        args = (stacked_layers, h, cos, sin) if mask is None else (stacked_layers, h, cos, sin, mask)
+        body = (lambda l, hh, c, s: local_fn(l, hh, c, s, None)) if mask is None else local_fn
+        shard_fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(layers_specs,) + other_specs,
+            out_specs=P(),
+            axis_names={MESH_AXIS_PIPELINE},
+        )
+        return shard_fn(*args)
+
+    return fn

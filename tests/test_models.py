@@ -64,9 +64,10 @@ def test_llama_tp_sharding_applied():
     rules = PartitionRules(model.partition_rules())
     shardings = infer_shardings(params, state.mesh, rules)
     wq_spec = shardings["layers"]["wq"].spec
-    assert wq_spec == jax.sharding.PartitionSpec(None, None, "tensor")
+    # leading dim carries the (size-1 here) pipeline axis; last dim is TP
+    assert wq_spec == jax.sharding.PartitionSpec("pipeline", None, "tensor")
     wo_spec = shardings["layers"]["wo"].spec
-    assert wo_spec == jax.sharding.PartitionSpec(None, "tensor", None)
+    assert wo_spec == jax.sharding.PartitionSpec("pipeline", "tensor", None)
 
 
 def test_llama_tp_forward_matches_single_device():
