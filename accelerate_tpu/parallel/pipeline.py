@@ -32,6 +32,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.constants import MESH_AXIS_PIPELINE, MESH_AXIS_SEQUENCE
 
 
+def _is_narrow_float(dtype) -> bool:
+    """bf16/fp16 (anything a pipeline-axis psum must be promoted around)."""
+    return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits < 32
+
+
 def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
     """Build ``fn(stacked_layer_params, h, cos, sin, mask) -> h`` running the
     decoder stack as a pipeline over the ``pipeline`` mesh axis.
@@ -50,10 +55,22 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
         raise ValueError(f"num_layers={cfg.num_layers} must divide pipeline size {nstages}")
     M = num_microbatches
 
-    def local_fn(layers, h, cos, sin, mask):
+    def local_fn(layers, h, cos, sin, mask, dtypes=None):
         # manual over pipeline only: h/cos/sin/mask are GLOBAL here (their
         # data/tensor shardings are still handled by GSPMD in auto mode)
         idx = jax.lax.axis_index(MESH_AXIS_PIPELINE)
+
+        def to_varying(x):
+            have = set(getattr(x.aval, "vma", ()) or ())
+            missing = tuple({MESH_AXIS_PIPELINE} - have)
+            return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+        # Become pipeline-varying while still fp32 (fn() widens narrow floats at
+        # the shard_map boundary): the transpose of this pcast is the psum that
+        # carries grads back to the replicated inputs, and a bf16/fp16 psum from
+        # a manual region crashes XLA's AllReducePromotion pass.
+        if dtypes is not None:
+            h, cos, sin = (to_varying(x).astype(d) for x, d in zip((h, cos, sin), dtypes))
 
         def stage(h_mb, mask_mb):
             def body(hh, lp):
@@ -76,17 +93,11 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
             mask_mb_all = jnp.ones((M, b // M, 1, 1, h.shape[1]), bool)
         else:
             mask_mb_all = mask.reshape(M, b // M, *mask.shape[1:])
-        state = jnp.zeros_like(mb[0])
-        state_mask = jnp.ones_like(mask_mb_all[0])
-        outputs = jnp.zeros_like(mb)
         # the loop makes these pipeline-varying (stage-dependent values); the
         # initial carry must already carry that type for scan to typecheck
-        have = set(getattr(h.aval, "vma", ()) or ())
-        missing = tuple({MESH_AXIS_PIPELINE} - have)
-        if missing:
-            state = jax.lax.pcast(state, missing, to="varying")
-            state_mask = jax.lax.pcast(state_mask, missing, to="varying")
-            outputs = jax.lax.pcast(outputs, missing, to="varying")
+        state = to_varying(jnp.zeros_like(mb[0]))
+        state_mask = to_varying(jnp.ones_like(mask_mb_all[0]))
+        outputs = to_varying(jnp.zeros_like(mb))
         fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
 
         def tick(carry, t):
@@ -114,22 +125,45 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
 
         ticks = jnp.arange(M + nstages - 1)
         (_, _, outputs), _ = jax.lax.scan(tick, (state, state_mask, outputs), ticks)
-        # fan the last stage's collected outputs out to every stage
+        # fan the last stage's collected outputs out to every stage; the psum is
+        # exact because every other stage contributes zeros. Promote bf16/fp16 to
+        # fp32 around the collective: XLA's AllReducePromotion pass crashes on a
+        # low-precision all-reduce emitted from a manual shard_map region
+        # ("Invalid binary instruction opcode copy"), and fp32<->bf16 round-trip
+        # of bf16 values is lossless.
+        out_dtype = outputs.dtype
         outputs = jnp.where(idx == nstages - 1, outputs, jnp.zeros_like(outputs))
-        outputs = jax.lax.psum(outputs, MESH_AXIS_PIPELINE)
+        if _is_narrow_float(out_dtype):
+            outputs = jax.lax.psum(outputs.astype(jnp.float32), MESH_AXIS_PIPELINE)
+            outputs = outputs.astype(out_dtype)
+        else:
+            outputs = jax.lax.psum(outputs, MESH_AXIS_PIPELINE)
         return outputs.reshape(h.shape)
 
     def fn(stacked_layers, h, cos, sin, mask):
         if cos.shape[0] != 1:
             raise NotImplementedError("per-row positions are not supported in the pipeline schedule")
+        # Replicated float operands cross the shard_map boundary in fp32: the
+        # transpose of the implicit pipeline-axis broadcast of a replicated
+        # input is a psum, and a bf16/fp16 psum from a manual region crashes
+        # XLA's AllReducePromotion pass. Widening is lossless; compute inside
+        # still runs at the caller's dtype.
+        dtypes = (h.dtype, cos.dtype, sin.dtype)
+        wide = tuple(
+            x.astype(jnp.float32) if _is_narrow_float(x.dtype) else x for x in (h, cos, sin)
+        )
+
+        def body(l, hh, c, s, m):
+            return local_fn(l, hh, c, s, m, dtypes=dtypes)
+
         # only the pipeline placement is manual; every other dim/axis is left
         # to GSPMD (tensor/fsdp shardings keep working inside the stage)
         layers_specs = jax.tree.map(lambda _: P(MESH_AXIS_PIPELINE), stacked_layers)
         other_specs = (P(), P(), P()) if mask is None else (P(), P(), P(), P())
-        args = (stacked_layers, h, cos, sin) if mask is None else (stacked_layers, h, cos, sin, mask)
-        body = (lambda l, hh, c, s: local_fn(l, hh, c, s, None)) if mask is None else local_fn
+        args = (stacked_layers,) + wide if mask is None else (stacked_layers,) + wide + (mask,)
+        wrapped = (lambda l, hh, c, s: body(l, hh, c, s, None)) if mask is None else body
         shard_fn = shard_map(
-            body,
+            wrapped,
             mesh=mesh,
             in_specs=(layers_specs,) + other_specs,
             out_specs=P(),

@@ -95,3 +95,38 @@ def test_pipeline_rejects_indivisible_layers():
     cfg = get_config("llama-tiny")  # 2 layers, pipeline 8
     with pytest.raises(ValueError, match="must divide"):
         make_pipeline_layers_fn(cfg, state.mesh, num_microbatches=4)
+
+def test_pipeline_bf16_full_step_with_tp_fsdp():
+    """Regression: bf16 + pipeline (the driver dryrun config) used to crash XLA's
+    AllReducePromotion pass via low-precision psums emitted from the manual
+    shard_map region (pipeline.py). Run the fused compiled_step end-to-end."""
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        gradient_accumulation_steps=2,
+        parallelism=ParallelismConfig(fsdp=2, pipeline=2, tensor=2),
+    )
+    model = Llama("llama-tiny")
+    accelerator.prepare_model(model)
+    accelerator.prepare_optimizer(optax.adamw(1e-3))
+    step = accelerator.compiled_step(Llama.loss_fn(model), clip_grad_norm=1.0)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (16, 32)), jnp.int32)
+    batch = {"input_ids": jax.device_put(ids, accelerator.state.data_sharding())}
+    losses = [float(step(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_bf16_forward_matches_single_device():
+    """bf16 pipeline forward must agree with the bf16 single-device forward."""
+    model, params = _fresh_model(seed=3)
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 1024, (8, 16)), jnp.int32)
+    expected = model.apply(params16, ids)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params16)
+    got = prepared(ids)
+    np.testing.assert_allclose(
+        np.asarray(expected, np.float32), np.asarray(got, np.float32), atol=1.5e-1
+    )
