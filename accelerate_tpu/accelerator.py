@@ -205,7 +205,7 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[BaseDataLoader] = []
         self._custom_objects: list = []
-        self._grad_fns: dict[int, Callable] = {}
+        self._grad_fns: dict[tuple, Callable] = {}
         self._accum_step = 0
         self.step = 0
         self.trackers: list = []
@@ -444,8 +444,12 @@ class Accelerator:
     # the step: backward / clip / accumulate
     # ------------------------------------------------------------------
 
+    _GRAD_FN_CACHE_LIMIT = 16
+
     def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel, has_aux: bool) -> Callable:
-        key = (id(loss_fn), id(model), has_aux)
+        # key holds a strong reference to loss_fn: ids of collected objects are
+        # reused, so an id()-only key could serve a stale compiled grad fn.
+        key = (loss_fn, id(model), has_aux)
         if key not in self._grad_fns:
             policy = self.state.precision_policy
             remat_policy = self.compilation_config.checkpoint_policy()
@@ -469,6 +473,15 @@ class Accelerator:
                 value, grads = grad_fn(params, batch, scale)
                 return value, grads
 
+            if len(self._grad_fns) >= self._GRAD_FN_CACHE_LIMIT:
+                evicted = next(iter(self._grad_fns))
+                del self._grad_fns[evicted]
+                logger.warning_once(
+                    "backward() has compiled more than "
+                    f"{self._GRAD_FN_CACHE_LIMIT} distinct loss functions — pass a "
+                    "stable callable (not a fresh lambda per step) to avoid "
+                    "recompiling every step."
+                )
             self._grad_fns[key] = run
         return self._grad_fns[key]
 
@@ -489,17 +502,17 @@ class Accelerator:
         # route grads to the optimizer bound to THIS model's params (multi-model
         # setups like GANs prepare several pairs)
         optimizer = next((opt for opt in self._optimizers if opt._box is model.box), None)
-        scale = (
-            optimizer.scale
-            if optimizer is not None and optimizer.scale is not None
-            else jnp.float32(1.0)
-        )
+        if optimizer is None:
+            raise ValueError(
+                "backward() computed gradients but no optimizer is prepared for "
+                "this model, so they would be silently dropped. Call "
+                "prepare(optimizer) first, or use jax.grad on your loss function "
+                "directly if you only want gradients."
+            )
+        scale = optimizer.scale if optimizer.scale is not None else jnp.float32(1.0)
         run = self._get_grad_fn(loss_fn, model, has_aux)
         value, grads = run(model.params, batch, scale)
-        if optimizer is not None:
-            optimizer.accumulate_grads(grads)
-        else:
-            self._loose_grads = grads
+        optimizer.accumulate_grads(grads)
         if has_aux:
             loss, aux = value
             return loss / scale, aux
@@ -592,18 +605,20 @@ class Accelerator:
         num_micro = self.gradient_state.num_steps
         tx = optimizer.tx
         remat_policy = self.compilation_config.checkpoint_policy()
+        scaler_cfg = optimizer.scaler  # fp16 dynamic loss scaling (None otherwise)
 
-        def loss_of(params, batch):
+        def loss_of(params, batch, scale):
             fn = loss_fn
             if remat_policy is not None:
                 fn = jax.checkpoint(fn, policy=remat_policy)
-            return fn(cast_floating(params, policy.compute_dtype), cast_floating(batch, policy.compute_dtype))
+            loss = fn(cast_floating(params, policy.compute_dtype), cast_floating(batch, policy.compute_dtype))
+            return loss.astype(jnp.float32) * scale
 
-        def step_impl(params, opt_state, batch):
+        def step_impl(params, opt_state, batch, scale, growth_tracker):
             if num_micro > 1:
                 def micro(carry, mb):
                     grads_acc, loss_acc = carry
-                    loss, grads = jax.value_and_grad(loss_of)(params, mb)
+                    loss, grads = jax.value_and_grad(loss_of)(params, mb, scale)
                     return (jax.tree.map(jnp.add, grads_acc, grads), loss_acc + loss), None
 
                 zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -614,21 +629,55 @@ class Accelerator:
                 grads = jax.tree.map(lambda g: g / num_micro, grads)
                 loss = loss / num_micro
             else:
-                loss, grads = jax.value_and_grad(loss_of)(params, batch)
+                loss, grads = jax.value_and_grad(loss_of)(params, batch, scale)
+            grads = jax.tree.map(lambda g: g / scale, grads)
+            gnorm = optax.global_norm(grads)
             if clip_grad_norm is not None:
-                gnorm = optax.global_norm(grads)
                 factor = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * factor, grads)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+
+            # unscale the reported loss with the scale it was computed under,
+            # before the scaler bookkeeping below mutates `scale`
+            loss = loss / scale
+            if scaler_cfg is not None:
+                # GradScaler semantics (same as AcceleratedOptimizer._build_update_fn):
+                # skip the update on overflow, back off the scale; grow it after
+                # growth_interval consecutive finite steps.
+                finite = jnp.isfinite(gnorm)
+
+                def do_update(args):
+                    params, opt_state, grads = args
+                    updates, new_state = tx.update(grads, opt_state, params)
+                    return optax.apply_updates(params, updates), new_state
+
+                params, opt_state = jax.lax.cond(
+                    finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
+                )
+                growth_tracker = jnp.where(finite, growth_tracker + 1, 0)
+                grew = growth_tracker >= scaler_cfg.growth_interval
+                scale = jnp.where(
+                    finite,
+                    jnp.where(grew, scale * scaler_cfg.growth_factor, scale),
+                    scale * scaler_cfg.backoff_factor,
+                )
+                growth_tracker = jnp.where(grew, 0, growth_tracker)
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, scale, growth_tracker
 
         jitted = jax.jit(step_impl, donate_argnums=(0, 1))
 
         def step(batch):
-            params, opt_state, loss = jitted(model.params, optimizer.opt_state, batch)
+            scale = optimizer.scale if optimizer.scale is not None else jnp.float32(1.0)
+            growth = optimizer.growth_tracker if optimizer.growth_tracker is not None else jnp.int32(0)
+            params, opt_state, loss, scale, growth = jitted(
+                model.params, optimizer.opt_state, batch, scale, growth
+            )
             model.params = params
             optimizer.opt_state = opt_state
+            if scaler_cfg is not None:
+                optimizer.scale, optimizer.growth_tracker = scale, growth
             optimizer._step_count += 1
             return loss
 
@@ -648,14 +697,12 @@ class Accelerator:
             data = ops.gather_object(input_data)
         else:
             data = ops.gather(input_data)
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _truncate(t):
-                    return t[: self.gradient_state.remainder]
-
-                data = ops.recursively_apply(_truncate, data)
-        except Exception:
-            pass
+        # GradientState defaults are safe with no active loader
+        # (end_of_dataloader=False, remainder=-1), so no exception guard: a
+        # shape bug here should surface, not silently return duplicated samples.
+        remainder = self.gradient_state.remainder
+        if self.gradient_state.end_of_dataloader and remainder > 0:
+            data = ops.recursively_apply(lambda t: t[:remainder], data)
         return data
 
     def reduce(self, tensor, reduction: str = "mean", scale: float = 1.0):

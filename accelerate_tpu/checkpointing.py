@@ -114,9 +114,17 @@ def _save_flat(flat: dict[str, np.ndarray], path: str, safe_serialization: bool 
 
 def _load_flat(path: str) -> dict[str, np.ndarray]:
     if path.endswith(".safetensors"):
-        from safetensors.numpy import load_file
+        # _save_flat falls back to .npz when safetensors is not installed;
+        # mirror that on load so a save→load round-trip works either way.
+        npz_sibling = path.replace(".safetensors", ".npz")
+        if os.path.exists(path):
+            from safetensors.numpy import load_file
 
-        return load_file(path)
+            return load_file(path)
+        if os.path.exists(npz_sibling):
+            path = npz_sibling
+        else:
+            raise FileNotFoundError(f"Neither {path} nor {npz_sibling} exists")
     with np.load(path, allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
 

@@ -7,7 +7,10 @@ when ``split_batches=False`` (73-82).
 In optax the schedule is a pure function of the update count and is usually
 baked into the transformation; this wrapper exists so user loops keep the
 familiar ``scheduler.step()`` / ``get_last_lr()`` shape and so checkpoints
-carry the schedule position explicitly.
+carry the schedule position explicitly. When the schedule lives inside the
+optax transformation, the wrapper's counter is advisory: ``get_last_lr()``
+reports ``schedule_fn(counter)``, while the LR actually applied follows the
+transformation's own update count (one per optimizer step).
 """
 
 from __future__ import annotations
@@ -15,6 +18,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from .state import AcceleratorState, GradientState
+from .utils.constants import MESH_AXIS_DATA, MESH_AXIS_FSDP
 
 
 class AcceleratedScheduler:
@@ -43,10 +47,14 @@ class AcceleratedScheduler:
         if self.split_batches:
             self._counter += 1
         else:
-            # One SPMD process == the whole data-parallel group, but schedules
-            # written for per-worker semantics expect num_processes ticks per
-            # global step (reference scheduler.py:73-82).
-            num = AcceleratorState().num_processes
+            # Schedules written for per-worker semantics expect one tick per
+            # data-parallel worker per global step (reference scheduler.py:73-82,
+            # where num_processes == world size). The equivalent extent here is
+            # the number of batch shards — the data*fsdp mesh extent — NOT
+            # jax.process_count() (hosts), which would under-tick by the
+            # chips-per-host factor.
+            shape = dict(AcceleratorState().mesh.shape)
+            num = shape.get(MESH_AXIS_DATA, 1) * shape.get(MESH_AXIS_FSDP, 1)
             self._counter += num
 
     def get_last_lr(self) -> list[float]:

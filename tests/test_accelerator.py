@@ -1,6 +1,7 @@
 """End-to-end Accelerator tests: training parity, accumulation, clipping,
 checkpoint round-trip (reference tests/test_accelerator.py + test_script.py)."""
 
+import os
 import numpy as np
 import optax
 import pytest
@@ -268,9 +269,11 @@ def test_scheduler_steps_with_optimizer():
             optimizer.step()
             scheduler.step()
             optimizer.zero_grad()
-    # 8 batches / accum 2 = 4 optimizer steps; num_processes=1
-    assert scheduler.step_count == 4
-    assert scheduler.get_last_lr()[0] == pytest.approx(1.0 - 4 / 100)
+    # 8 batches / accum 2 = 4 optimizer steps; with split_batches=False the
+    # counter ticks once per data-parallel worker (reference scheduler.py:73-82)
+    # and the default mesh puts all 8 devices on the data axis -> 4 * 8.
+    assert scheduler.step_count == 4 * 8
+    assert scheduler.get_last_lr()[0] == pytest.approx(1.0 - 32 / 100)
 
 
 def test_trigger_primitive():
@@ -279,3 +282,123 @@ def test_trigger_primitive():
     accelerator.set_trigger()
     assert accelerator.check_trigger()
     assert not accelerator.check_trigger()  # reset after firing
+
+
+def test_backward_without_optimizer_raises():
+    """Grads with no optimizer prepared would be silently dropped — must raise."""
+    accelerator = Accelerator()
+    model = accelerator.prepare(LinearModel())
+    batch = {"x": jnp.ones((8,)), "y": jnp.ones((8,))}
+    with pytest.raises(ValueError, match="no optimizer"):
+        accelerator.backward(loss_fn, batch)
+
+
+def test_grad_fn_cache_holds_strong_refs_and_is_bounded():
+    accelerator = Accelerator()
+    model, optimizer, _ = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    batch = {"x": jnp.ones((8,)), "y": jnp.ones((8,))}
+    limit = accelerator._GRAD_FN_CACHE_LIMIT
+    for i in range(limit + 3):
+        def fresh_loss(params, b, _i=i):  # distinct code object per iteration
+            pred = LinearModel.apply(params, b["x"])
+            return jnp.mean((pred - b["y"]) ** 2) + 0.0 * _i
+        accelerator.backward(fresh_loss, batch)
+    assert len(accelerator._grad_fns) <= limit
+    # keys hold the loss_fn object itself (strong ref), not just its id
+    assert all(callable(k[0]) for k in accelerator._grad_fns)
+
+
+def test_compiled_step_fp16_applies_loss_scaling():
+    """compiled_step must run GradScaler semantics: params move on finite steps
+    and a synthetic overflow skips the update and backs off the scale."""
+    accelerator = Accelerator(mixed_precision="fp16")
+    model, optimizer, _ = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    step = accelerator.compiled_step(loss_fn)
+    init_scale = float(optimizer.scale)
+    batch = {"x": jnp.linspace(-1, 1, 8), "y": 2 * jnp.linspace(-1, 1, 8) + 3}
+    # the first steps overflow by design (the scaled cotangent exceeds fp16
+    # max), backing the scale off until an update fits and applies
+    for _ in range(5):
+        loss0 = float(step(batch))
+        assert np.isfinite(loss0)
+        if float(jax.device_get(model.params)["b"]) != 0.0:
+            break
+    assert float(optimizer.scale) < init_scale  # backoff happened
+    moved = jax.device_get(model.params)
+    assert float(moved["b"]) != 0.0  # update applied once the scale fit
+    scale_before = float(optimizer.scale)
+    # overflow batch: inf target makes grads non-finite -> skip + backoff
+    params_snapshot = jax.device_get(model.params)
+    bad = {"x": jnp.ones((8,)), "y": jnp.full((8,), np.inf, jnp.float32)}
+    step(bad)
+    after = jax.device_get(model.params)
+    np.testing.assert_allclose(float(after["a"]), float(params_snapshot["a"]))
+    np.testing.assert_allclose(float(after["b"]), float(params_snapshot["b"]))
+    assert float(optimizer.scale) < scale_before
+
+
+def test_compiled_step_fp16_matches_eager_path():
+    """fp16 compiled_step and the backward()/step() path must produce the same
+    parameters on finite data (both implement the same scaler semantics)."""
+    a1 = Accelerator(mixed_precision="fp16")
+    model1, opt1, loader1 = a1.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    step = a1.compiled_step(loss_fn)
+    for batch in loader1:
+        step(batch)
+    fused = jax.device_get(model1.params)
+    scale_fused = float(opt1.scale)
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    a2 = Accelerator(mixed_precision="fp16")
+    model2, opt2, loader2 = a2.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    for batch in loader2:
+        with a2.accumulate(model2):
+            a2.backward(loss_fn, batch)
+            opt2.step()
+            opt2.zero_grad()
+    eager = jax.device_get(model2.params)
+    np.testing.assert_allclose(float(fused["a"]), float(eager["a"]), rtol=1e-4)
+    np.testing.assert_allclose(float(fused["b"]), float(eager["b"]), rtol=1e-4)
+    assert scale_fused == float(opt2.scale)
+
+
+def test_scheduler_counter_scales_with_data_extent():
+    """!split_batches compensation ticks by the data-parallel extent (batch
+    shards), not the host count."""
+    from accelerate_tpu.scheduler import AcceleratedScheduler
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(data=4, tensor=2))
+    model, optimizer, _ = accelerator.prepare(LinearModel(), optax.sgd(0.1), _make_data())
+    sched = AcceleratedScheduler(lambda c: 0.1 / (1 + c), optimizer=optimizer)
+    accelerator.gradient_state._set_sync_gradients(True)
+    batch = {"x": jnp.ones((8,)), "y": jnp.ones((8,))}
+    accelerator.backward(loss_fn, batch)
+    optimizer.step()
+    sched.step()
+    assert sched.step_count == 4  # data extent, tensor axis doesn't tick
+
+
+def test_checkpoint_npz_fallback_roundtrip(tmp_path, monkeypatch):
+    """save without safetensors writes .npz; load must find it."""
+    import accelerate_tpu.checkpointing as ck
+
+    flat = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    target = str(tmp_path / "model_0.safetensors")
+    # simulate missing safetensors at save time
+    import builtins
+    real_import = builtins.__import__
+
+    def no_safetensors(name, *args, **kwargs):
+        if name.startswith("safetensors"):
+            raise ImportError("simulated absence")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_safetensors)
+    ck._save_flat(flat, target, safe_serialization=True)
+    monkeypatch.setattr(builtins, "__import__", real_import)
+    assert not os.path.exists(target)
+    loaded = ck._load_flat(target)  # resolves the .npz sibling
+    np.testing.assert_array_equal(loaded["w"], flat["w"])
