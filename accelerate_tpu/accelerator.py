@@ -173,6 +173,18 @@ class Accelerator:
         self.fsdp_plugin = fsdp_plugin
         self.model_parallel_plugin = model_parallel_plugin
         self.compilation_config = compilation_config or CompilationConfig()
+        if (
+            fsdp_plugin is not None
+            and fsdp_plugin.activation_checkpointing
+            and self.compilation_config.remat_policy is None
+        ):
+            # FSDP plugin activation checkpointing ≙ remat everything but matmul
+            # outputs (reference accelerator.py:1450-1464 applies torch
+            # checkpoint wrappers post-wrap; here it is a jax.checkpoint policy).
+            # Copy: the config object is caller-owned and may be shared.
+            import dataclasses as _dc
+
+            self.compilation_config = _dc.replace(self.compilation_config, remat_policy="dots_saveable")
 
         if self.state.mixed_precision == "fp16" and self.loss_scale_kwargs is None:
             self.loss_scale_kwargs = LossScaleKwargs()
@@ -313,7 +325,10 @@ class Accelerator:
             rules.extend(self.model_parallel_plugin.partition_rules)
         if hasattr(module, "partition_rules"):
             rules.extend(module.partition_rules())
-        return PartitionRules(rules, fsdp_plugin=self.fsdp_plugin)
+        # ZeRO stage 1/2: parameters replicated over fsdp, only optimizer state
+        # shards (prepare_optimizer derives that layout via with_fsdp_applied)
+        stage3 = self.fsdp_plugin is None or self.fsdp_plugin.stage >= 3
+        return PartitionRules(rules, fsdp_plugin=self.fsdp_plugin, apply_fsdp_to_params=stage3)
 
     def prepare_model(self, model: Any, params: Any = None, device_placement: Optional[bool] = None) -> PreparedModel:
         """Bind a model to sharded global parameters.
@@ -363,11 +378,24 @@ class Accelerator:
             if not self._models:
                 raise ValueError("Prepare (or pass) the model before its optimizer.")
             model = self._models[-1]
+        opt_reference_shardings = None
+        cpu_offload = False
+        if self.fsdp_plugin is not None:
+            cpu_offload = self.fsdp_plugin.cpu_offload
+            if self.fsdp_plugin.stage < 3:
+                # ZeRO stage 1/2: optimizer state shards over fsdp even though
+                # the params are replicated (weight-update sharding)
+                from .parallel.sharding import infer_shardings
+
+                rules = self._partition_rules(model.module).with_fsdp_applied()
+                opt_reference_shardings = infer_shardings(model.params, self.mesh, rules)
         optimizer = AcceleratedOptimizer(
             tx,
             model.box,
             model.params_shardings,
             scaler=self.loss_scale_kwargs if self.state.precision_policy.requires_loss_scaling else None,
+            opt_reference_shardings=opt_reference_shardings,
+            cpu_offload=cpu_offload,
         )
         self._optimizers.append(optimizer)
         return optimizer
@@ -664,6 +692,11 @@ class Accelerator:
             else:
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
+            # pin output layouts: keeps the ZeRO stage-1/2 replicated-params
+            # invariant and the moment shardings stable under GSPMD propagation,
+            # via in-program constraints so buffer donation stays usable
+            params = jax.lax.with_sharding_constraint(params, model.params_shardings)
+            opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
             return params, opt_state, loss, scale, growth_tracker
 
         jitted = jax.jit(step_impl, donate_argnums=(0, 1))
@@ -671,11 +704,16 @@ class Accelerator:
         def step(batch):
             scale = optimizer.scale if optimizer.scale is not None else jnp.float32(1.0)
             growth = optimizer.growth_tracker if optimizer.growth_tracker is not None else jnp.int32(0)
+            opt_state_in = optimizer.opt_state
+            if optimizer.cpu_offload:
+                opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)
             params, opt_state, loss, scale, growth = jitted(
-                model.params, optimizer.opt_state, batch, scale, growth
+                model.params, opt_state_in, batch, scale, growth
             )
             model.params = params
             optimizer.opt_state = opt_state
+            if optimizer.cpu_offload:
+                optimizer.opt_state = jax.device_put(opt_state, optimizer._opt_state_shardings)
             if scaler_cfg is not None:
                 optimizer.scale, optimizer.growth_tracker = scale, growth
             optimizer._step_count += 1

@@ -34,6 +34,8 @@ class AcceleratedOptimizer:
         params_shardings: Any,
         scaler: Optional[LossScaleKwargs] = None,
         clip_grad_norm: Optional[float] = None,
+        opt_reference_shardings: Any = None,  # ZeRO stage 1/2: sharded layout for moments
+        cpu_offload: bool = False,
     ):
         import optax
 
@@ -43,14 +45,35 @@ class AcceleratedOptimizer:
         self.scaler = scaler
         self._box = params_box
         self._params_shardings = params_shardings
+        self.cpu_offload = cpu_offload
+
+        from jax.sharding import NamedSharding
 
         from .parallel.sharding import replicated, shardings_like
 
         mesh = self.accelerator_state.mesh
         params = self._box.value
         state_shapes = jax.eval_shape(tx.init, params)
-        self._opt_state_shardings = shardings_like(state_shapes, params, params_shardings, mesh)
+        reference = opt_reference_shardings if opt_reference_shardings is not None else params_shardings
+        self._opt_state_shardings = shardings_like(state_shapes, params, reference, mesh)
         self.opt_state = jax.jit(tx.init, out_shardings=self._opt_state_shardings)(params)
+        self._opt_state_device_shardings = self._opt_state_shardings
+        if cpu_offload:
+            # optimizer state lives in host RAM between steps (reference:
+            # DeepSpeed/FSDP cpu_offload), moved with device_put outside jit
+            # (memory-kind annotations inside jit trip XLA's SPMD partitioner).
+            # Scalars (step counters) stay in device memory — pinning them
+            # saves nothing.
+            self._opt_state_shardings = jax.tree.map(
+                lambda s, shape: (
+                    NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
+                    if len(shape.shape) > 0
+                    else s
+                ),
+                self._opt_state_shardings,
+                state_shapes,
+            )
+            self.opt_state = jax.device_put(self.opt_state, self._opt_state_shardings)
 
         self._grads = None  # accumulated (sum) grads, lazily allocated
         self._accum_count = 0
@@ -135,6 +158,14 @@ class AcceleratedOptimizer:
                 updates, opt_state = self.tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 skipped = jnp.asarray(False)
+            # pin output layouts: without this GSPMD propagates the fsdp
+            # sharding of the moment buffers into the updated params (breaking
+            # the ZeRO stage-1/2 "params replicated" invariant) or conversely
+            # washes the moment shardings out to replicated. Constraints inside
+            # the program (rather than out_shardings) keep buffer donation
+            # usable.
+            params = jax.lax.with_sharding_constraint(params, self._params_shardings)
+            opt_state = jax.lax.with_sharding_constraint(opt_state, self._opt_state_device_shardings)
             return params, opt_state, scale, growth_tracker, skipped, gnorm
 
         return jax.jit(update, donate_argnums=(0, 1, 2))
@@ -144,6 +175,11 @@ class AcceleratedOptimizer:
             return
         if self._update_fn is None:
             self._update_fn = self._build_update_fn()
+        if self.cpu_offload:
+            # stream offloaded state into device memory for the update (the jit
+            # itself stays all-device: mixing memory spaces inside a traced
+            # program is rejected / trips the SPMD partitioner)
+            self.opt_state = jax.device_put(self.opt_state, self._opt_state_device_shardings)
         scale = self.scale if self.scale is not None else jnp.float32(1.0)
         growth = self.growth_tracker if self.growth_tracker is not None else jnp.int32(0)
         (
@@ -158,6 +194,10 @@ class AcceleratedOptimizer:
         )
         if self.scaler is not None:
             self.scale, self.growth_tracker = scale, growth
+        if self.cpu_offload:
+            # evict the fresh state back to host RAM (the jit's outputs land in
+            # device memory; sharding propagation does not preserve memory_kind)
+            self.opt_state = jax.device_put(self.opt_state, self._opt_state_shardings)
         self._grads = None
         self._accum_count = 0
         self._step_count += 1

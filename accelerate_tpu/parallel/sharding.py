@@ -100,10 +100,24 @@ class PartitionRules:
         rules: Sequence[tuple[str, tuple]] = (),
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         combine_fsdp: bool = True,
+        apply_fsdp_to_params: bool = True,
     ):
+        self._raw_rules = list(rules)
         self.rules = [(re.compile(pattern), tuple(spec)) for pattern, spec in rules]
         self.fsdp_plugin = fsdp_plugin
         self.combine_fsdp = combine_fsdp
+        # ZeRO stage 1/2: params stay replicated over fsdp (only optimizer
+        # state shards) — the rules engine then skips the fsdp auto/fold paths
+        # for parameters while with_fsdp_applied() still produces the sharded
+        # layout for the optimizer-state tree.
+        self.apply_fsdp_to_params = apply_fsdp_to_params
+
+    def with_fsdp_applied(self) -> "PartitionRules":
+        """Copy of these rules with fsdp sharding forced on (the optimizer-state
+        layout under ZeRO stage 1/2)."""
+        return PartitionRules(
+            self._raw_rules, self.fsdp_plugin, combine_fsdp=self.combine_fsdp, apply_fsdp_to_params=True
+        )
 
     def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
         for pattern, spec in self.rules:
@@ -111,9 +125,15 @@ class PartitionRules:
                 if not _spec_fits(shape, spec, mesh):
                     break  # rule exists but doesn't divide: fall back to auto
                 spec = list(spec) + [None] * (len(shape) - len(spec))
-                if self.combine_fsdp and mesh.shape.get(MESH_AXIS_FSDP, 1) > 1:
+                if (
+                    self.apply_fsdp_to_params
+                    and self.combine_fsdp
+                    and mesh.shape.get(MESH_AXIS_FSDP, 1) > 1
+                ):
                     spec = self._fold_in_fsdp(shape, spec, mesh)
                 return PartitionSpec(*spec)
+        if not self.apply_fsdp_to_params:
+            return PartitionSpec()
         return fsdp_auto_spec(shape, mesh, self.fsdp_plugin)
 
     def _fold_in_fsdp(self, shape, spec, mesh) -> list:

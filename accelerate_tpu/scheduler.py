@@ -41,7 +41,13 @@ class AcceleratedScheduler:
             self._counter += 1
             return
         if not self.gradient_state.sync_gradients:
-            return  # optimizer didn't step on this accumulation micro-step
+            # optimizer didn't step on this accumulation micro-step; with
+            # adjust_scheduler the schedule position still advances so LR
+            # schedules written for per-batch stepping keep their length
+            # (reference scheduler.py:62-64)
+            if self.gradient_state.adjust_scheduler:
+                self._counter += 1
+            return
         if self.optimizer is not None and self.optimizer.step_was_skipped:
             return  # fp16 overflow: optimizer didn't move, neither does the schedule
         if self.split_batches:

@@ -249,20 +249,25 @@ class FullyShardedDataParallelPlugin:
     and DeepSpeedPlugin ZeRO stages (dataclasses.py:663) into GSPMD terms:
 
     - stage 1/2 (optimizer/grad sharding): params replicated, optimizer state
-      sharded over ``fsdp`` (the "weight-update sharding" recipe).
+      sharded over ``fsdp`` (the "weight-update sharding" recipe; see
+      PartitionRules.apply_fsdp_to_params + AcceleratedOptimizer).
     - stage 3 / FULL_SHARD: params themselves sharded over ``fsdp``; XLA emits
       all-gather before use and reduce-scatter for grads.
-    - ``reshard_after_forward=False`` ≙ SHARD_GRAD_OP.
+    - ``cpu_offload``: optimizer state lives in pinned host RAM between steps
+      (≙ DeepSpeed/FSDP CPU offload), streamed per update.
     - ``min_weight_size`` ≙ size-based auto-wrap policy: tensors smaller than
       this stay replicated (gathering them costs more than it saves).
+
+    The reference's ``reshard_after_forward``/SHARD_GRAD_OP knob has no
+    equivalent here on purpose: forward and backward compile into one XLA
+    program, so whether gathered params persist between them is the XLA
+    scheduler's rematerialization decision, not a runtime flag.
     """
 
     fsdp_size: Optional[int] = None  # None = all devices not used by other axes
     stage: int = 3
-    reshard_after_forward: bool = True
     min_weight_size: int = 2**12
-    shard_largest_axis_only: bool = True
-    cpu_offload: bool = False  # keep sharded params/opt state in host RAM
+    cpu_offload: bool = False  # keep optimizer state in host RAM
     activation_checkpointing: bool = False
     state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT
 
@@ -271,7 +276,6 @@ class FullyShardedDataParallelPlugin:
         return cls(
             fsdp_size=parse_int_from_env("ACCELERATE_FSDP_SIZE"),
             stage=parse_int_from_env("ACCELERATE_FSDP_STAGE", 3),
-            reshard_after_forward=parse_flag_from_env("ACCELERATE_FSDP_RESHARD_AFTER_FORWARD", True),
             min_weight_size=parse_int_from_env("ACCELERATE_FSDP_MIN_WEIGHT_SIZE", 2**12),
             cpu_offload=parse_flag_from_env("ACCELERATE_FSDP_CPU_OFFLOAD", False),
             activation_checkpointing=parse_flag_from_env("ACCELERATE_FSDP_ACTIVATION_CHECKPOINTING", False),

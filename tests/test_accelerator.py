@@ -271,9 +271,11 @@ def test_scheduler_steps_with_optimizer():
             optimizer.zero_grad()
     # 8 batches / accum 2 = 4 optimizer steps; with split_batches=False the
     # counter ticks once per data-parallel worker (reference scheduler.py:73-82)
-    # and the default mesh puts all 8 devices on the data axis -> 4 * 8.
-    assert scheduler.step_count == 4 * 8
-    assert scheduler.get_last_lr()[0] == pytest.approx(1.0 - 32 / 100)
+    # and the default mesh puts all 8 devices on the data axis -> 4 * 8; the 4
+    # accumulation micro-steps add one tick each (adjust_scheduler=True default,
+    # reference scheduler.py:62-64).
+    assert scheduler.step_count == 4 * 8 + 4
+    assert scheduler.get_last_lr()[0] == pytest.approx(1.0 - 36 / 100)
 
 
 def test_trigger_primitive():
