@@ -172,6 +172,122 @@ def save_model_weights(
     state.wait_for_everyone()
 
 
+def _chunk_key(path: str, start: tuple[int, ...]) -> str:
+    return f"{path}@{','.join(map(str, start))}"
+
+
+def save_model_weights_sharded(
+    params: Any,
+    save_directory: str,
+    weights_name: str = "model.safetensors",
+    safe_serialization: bool = True,
+) -> None:
+    """Per-host sharded checkpoint writing (reference FSDP SHARDED_STATE_DICT,
+    utils/fsdp_utils.py:85-96): every process writes only the array chunks it
+    holds locally — no host gather, so a model that only fits sharded can
+    still be checkpointed. Each process emits
+
+        {base}.shard{p:05d}{ext}             its chunks, keyed "path@start0,start1"
+        {base}.shard{p:05d}.index.json       chunk table + global tensor metadata
+
+    and the loader reassembles/reshards from the union of shard indexes, so a
+    checkpoint saved on mesh A loads onto a different mesh B.
+    """
+    state = PartialState()
+    os.makedirs(save_directory, exist_ok=True)
+    proc = state.process_index
+    chunks: dict[str, np.ndarray] = {}
+    tensors: dict[str, dict] = {}
+
+    def _visit(key_path, leaf):
+        path = param_path(key_path)
+        tensors[path] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+        if hasattr(leaf, "addressable_shards"):
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # exactly one process writes each global chunk
+                start = tuple(int(sl.start or 0) for sl in shard.index)
+                chunks[_chunk_key(path, start)] = np.asarray(shard.data)
+        else:  # plain host array: single chunk, main process writes it
+            if state.is_main_process:
+                chunks[_chunk_key(path, (0,) * np.ndim(leaf))] = np.asarray(leaf)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(_visit, params)
+    base, ext = os.path.splitext(weights_name)
+    shard_name = f"{base}.shard{proc:05d}{ext}"
+    _save_flat(chunks, os.path.join(save_directory, shard_name), safe_serialization)
+    if not safe_serialization or not _has_safetensors():
+        shard_name = shard_name.replace(".safetensors", ".npz")
+    index = {
+        "metadata": {"format": "accelerate-tpu-sharded", "process": proc},
+        "tensors": tensors,
+        "chunks": {key: shard_name for key in chunks},
+    }
+    with open(os.path.join(save_directory, f"{base}.shard{proc:05d}.index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+    state.wait_for_everyone()
+
+
+def _has_safetensors() -> bool:
+    try:
+        import safetensors.numpy  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def load_model_weights_sharded(
+    directory: str, weights_name: str = "model.safetensors"
+) -> dict[str, np.ndarray]:
+    """Reassemble the flat weight dict from per-host shard files. Works across
+    topologies: chunks carry global offsets, so the result is the full global
+    tensor regardless of the mesh it was saved from."""
+    import glob as _glob
+
+    base, _ = os.path.splitext(weights_name)
+    index_files = sorted(_glob.glob(os.path.join(directory, f"{base}.shard*.index.json")))
+    if not index_files:
+        raise FileNotFoundError(f"No sharded index files for {weights_name} under {directory}")
+    tensors: dict[str, dict] = {}
+    chunk_files: dict[str, str] = {}
+    for index_path in index_files:
+        with open(index_path) as f:
+            index = json.load(f)
+        tensors.update(index["tensors"])
+        chunk_files.update(index["chunks"])
+
+    out: dict[str, np.ndarray] = {}
+    by_file: dict[str, list[str]] = {}
+    for key, fname in chunk_files.items():
+        by_file.setdefault(fname, []).append(key)
+    for fname, keys in by_file.items():
+        data = _load_flat(os.path.join(directory, fname))
+        for key in keys:
+            path, _, start_s = key.rpartition("@")
+            start = tuple(int(s) for s in start_s.split(",")) if start_s else ()
+            chunk = data[key]
+            if path not in out:
+                out[path] = np.empty(tuple(tensors[path]["shape"]), dtype=chunk.dtype)
+            if chunk.ndim == 0:
+                out[path] = chunk
+            else:
+                slices = tuple(slice(o, o + s) for o, s in zip(start, chunk.shape))
+                out[path][slices] = chunk
+    missing = set(tensors) - set(out)
+    if missing:
+        raise FileNotFoundError(f"Sharded checkpoint is missing chunks for: {sorted(missing)[:5]}")
+    return out
+
+
+def is_sharded_checkpoint(directory: str, weights_name: str = "model.safetensors") -> bool:
+    import glob as _glob
+
+    base, _ = os.path.splitext(weights_name)
+    return bool(_glob.glob(os.path.join(directory, f"{base}.shard*.index.json")))
+
+
 def load_model_weights(path: str) -> dict[str, np.ndarray]:
     """Load a flat weight dict from a file, a shard-index, or a directory."""
     if os.path.isdir(path):

@@ -1,0 +1,219 @@
+"""Import HuggingFace/torch-layout checkpoints into the JAX param tree.
+
+Parity: reference ``load_checkpoint_in_model`` (utils/modeling.py:1541) +
+weight-name resolution and tied-parameter handling (utils/modeling.py:606-693).
+The reference loads shard-by-shard into an existing torch module by attribute
+path; here the torch naming scheme is *translated* into the stacked-layer
+pytree layout the TPU models use:
+
+- torch ``nn.Linear.weight`` is ``[out, in]`` and is applied as ``x @ W.T``;
+  our projections are stored ``[in, out]`` and applied as ``x @ W`` — every
+  projection is transposed on import.
+- per-layer tensors ``model.layers.{i}.*`` are stacked on a leading L axis
+  (the ``lax.scan`` layout).
+- tied embeddings: when ``lm_head.weight`` is absent the config must have
+  ``tie_embeddings=True`` (the forward then reuses ``embed_tokens.T``), and a
+  present-but-tied lm_head is detected by pointer-identity in torch land /
+  value-identity here and dropped.
+
+Supports the standard HF repo layout: a single ``model.safetensors``, a
+``model.safetensors.index.json`` shard index, or a directory holding either.
+``.npz`` files with the same key naming also work (for installs without
+safetensors).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# torch-name → (our path, needs_transpose). {i} is the layer index.
+_HF_LLAMA_LAYER_MAP = {
+    "model.layers.{i}.self_attn.q_proj.weight": ("layers/wq", True),
+    "model.layers.{i}.self_attn.k_proj.weight": ("layers/wk", True),
+    "model.layers.{i}.self_attn.v_proj.weight": ("layers/wv", True),
+    "model.layers.{i}.self_attn.o_proj.weight": ("layers/wo", True),
+    "model.layers.{i}.mlp.gate_proj.weight": ("layers/w_gate", True),
+    "model.layers.{i}.mlp.up_proj.weight": ("layers/w_up", True),
+    "model.layers.{i}.mlp.down_proj.weight": ("layers/w_down", True),
+    "model.layers.{i}.input_layernorm.weight": ("layers/attn_norm", False),
+    "model.layers.{i}.post_attention_layernorm.weight": ("layers/mlp_norm", False),
+}
+_HF_LLAMA_TOP_MAP = {
+    "model.embed_tokens.weight": ("embed_tokens", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),
+}
+
+
+def load_hf_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Flat {torch_name: numpy} from a file, shard index, or directory."""
+    if os.path.isdir(path):
+        for candidate in ("model.safetensors.index.json", "model.safetensors", "model.npz"):
+            full = os.path.join(path, candidate)
+            if os.path.exists(full):
+                path = full
+                break
+        else:
+            raise FileNotFoundError(f"No HF-layout weights under {path}")
+    if path.endswith(".index.json"):
+        with open(path) as f:
+            index = json.load(f)
+        directory = os.path.dirname(path)
+        flat: dict[str, np.ndarray] = {}
+        for shard in sorted(set(index["weight_map"].values())):
+            flat.update(_load_one(os.path.join(directory, shard)))
+        return flat
+    return _load_one(path)
+
+
+def _load_one(path: str) -> dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def looks_like_hf_checkpoint(flat: dict) -> bool:
+    return any(k.startswith("model.") or k == "lm_head.weight" for k in flat)
+
+
+def import_hf_llama(
+    flat: dict[str, np.ndarray],
+    config,
+    dtype: Optional[Any] = None,
+) -> dict:
+    """HF-layout flat dict → our stacked-layer llama param tree (numpy leaves).
+
+    ``config`` is a TransformerConfig; shapes are validated against it.
+    Raises KeyError on missing tensors and ValueError on shape mismatches so a
+    wrong-config import fails loudly rather than silently truncating.
+    """
+    L = config.num_layers
+    h = config.hidden_size
+    consumed = set()
+
+    def take(name: str, transpose: bool) -> np.ndarray:
+        if name not in flat:
+            raise KeyError(f"HF checkpoint is missing {name!r}")
+        consumed.add(name)
+        value = np.asarray(flat[name])
+        return value.T if transpose else value
+
+    params: dict[str, Any] = {}
+    params["embed_tokens"] = take("model.embed_tokens.weight", False)
+    params["final_norm"] = take("model.norm.weight", False)
+
+    layers: dict[str, np.ndarray] = {}
+    for torch_tpl, (ours, transpose) in _HF_LLAMA_LAYER_MAP.items():
+        key = ours.split("/")[1]
+        stacked = np.stack([take(torch_tpl.format(i=i), transpose) for i in range(L)])
+        layers[key] = stacked
+    params["layers"] = layers
+
+    if "lm_head.weight" in flat:
+        head = take("lm_head.weight", True)  # [h, v] after transpose
+        if config.tie_embeddings:
+            # torch ties by pointer; after serialization that becomes an equal
+            # copy — drop it and keep the single tied tensor
+            if not np.array_equal(head, params["embed_tokens"].T):
+                raise ValueError(
+                    "config.tie_embeddings=True but the checkpoint carries a "
+                    "distinct lm_head — set tie_embeddings=False for this model"
+                )
+            logger.info("Dropping tied lm_head (reusing embed_tokens)")
+        else:
+            params["lm_head"] = head
+    elif not config.tie_embeddings:
+        raise KeyError(
+            "HF checkpoint has no lm_head.weight and config.tie_embeddings is "
+            "False — either the checkpoint is tied (set tie_embeddings=True) or "
+            "it is incomplete"
+        )
+
+    # shape validation against the config
+    expect = {
+        "embed_tokens": (config.vocab_size, h),
+        "final_norm": (h,),
+    }
+    d, nh, nkv = config.dim_per_head, config.num_heads, config.kv_heads
+    i_sz = config.intermediate_size
+    layer_expect = {
+        "wq": (L, h, nh * d),
+        "wk": (L, h, nkv * d),
+        "wv": (L, h, nkv * d),
+        "wo": (L, nh * d, h),
+        "w_gate": (L, h, i_sz),
+        "w_up": (L, h, i_sz),
+        "w_down": (L, i_sz, h),
+        "attn_norm": (L, h),
+        "mlp_norm": (L, h),
+    }
+    for key, shape in expect.items():
+        if tuple(params[key].shape) != shape:
+            raise ValueError(f"{key}: checkpoint shape {params[key].shape} != config shape {shape}")
+    for key, shape in layer_expect.items():
+        if tuple(layers[key].shape) != shape:
+            raise ValueError(f"layers/{key}: checkpoint shape {layers[key].shape} != config shape {shape}")
+
+    unused = set(flat) - consumed - {"model.rotary_emb.inv_freq"} - {
+        k for k in flat if re.fullmatch(r"model\.layers\.\d+\.self_attn\.rotary_emb\.inv_freq", k)
+    }
+    if unused:
+        logger.warning(f"Ignoring {len(unused)} unused checkpoint tensors: {sorted(unused)[:5]}...")
+
+    if dtype is not None:
+        np_dtype = np.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+        params = _tree_astype(params, np_dtype)
+    return params
+
+
+def _tree_astype(tree, np_dtype):
+    import jax
+
+    return jax.tree.map(
+        lambda x: x.astype(np_dtype) if np.issubdtype(x.dtype, np.floating) else x, tree
+    )
+
+
+def export_hf_llama(params: dict, config) -> dict[str, np.ndarray]:
+    """Inverse of import_hf_llama: our tree → HF torch naming (for interop
+    round-trip tests and for handing checkpoints back to torch users)."""
+    flat: dict[str, np.ndarray] = {}
+    flat["model.embed_tokens.weight"] = np.asarray(params["embed_tokens"])
+    flat["model.norm.weight"] = np.asarray(params["final_norm"])
+    for torch_tpl, (ours, transpose) in _HF_LLAMA_LAYER_MAP.items():
+        key = ours.split("/")[1]
+        stacked = np.asarray(params["layers"][key])
+        for i in range(config.num_layers):
+            value = stacked[i]
+            flat[torch_tpl.format(i=i)] = value.T if transpose else value
+    if "lm_head" in params:
+        flat["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    return flat
+
+
+def load_checkpoint_in_model(model, checkpoint_path: str, dtype=None) -> dict:
+    """Reference load_checkpoint_in_model (utils/modeling.py:1541) for our
+    models: reads an HF-layout OR native-layout checkpoint and returns the
+    param tree (numpy leaves, ready for shard_tree/device_put)."""
+    flat = load_hf_state_dict(checkpoint_path)
+    if looks_like_hf_checkpoint(flat):
+        return import_hf_llama(flat, model.config, dtype=dtype)
+    # native flat layout ("embed_tokens", "layers/wq", ...): unflatten by path
+    import jax
+
+    from ..checkpointing import unflatten_into
+
+    abstract = jax.eval_shape(model.init, jax.random.key(0))
+    return unflatten_into(abstract, flat)
