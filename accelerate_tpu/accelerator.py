@@ -40,7 +40,7 @@ import jax.numpy as jnp
 
 from .data_loader import BaseDataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
-from .optimizer import AcceleratedOptimizer
+from .optimizer import AcceleratedOptimizer, clip_by_global_norm, scaled_optimizer_update
 from .ops import operations as ops
 from .parallel.sharding import PartitionRules, infer_shardings, replicated, shard_tree
 from .scheduler import AcceleratedScheduler
@@ -622,8 +622,6 @@ class Accelerator:
         This is what the reference's whole hot loop (SURVEY §3.3) compiles down
         to, and the path benchmarks should use.
         """
-        import optax
-
         if model is None:
             model = self._models[-1]
         optimizer = next((opt for opt in self._optimizers if opt._box is model.box), None)
@@ -659,39 +657,14 @@ class Accelerator:
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, batch, scale)
             grads = jax.tree.map(lambda g: g / scale, grads)
-            gnorm = optax.global_norm(grads)
-            if clip_grad_norm is not None:
-                factor = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * factor, grads)
+            grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
 
             # unscale the reported loss with the scale it was computed under,
             # before the scaler bookkeeping below mutates `scale`
             loss = loss / scale
-            if scaler_cfg is not None:
-                # GradScaler semantics (same as AcceleratedOptimizer._build_update_fn):
-                # skip the update on overflow, back off the scale; grow it after
-                # growth_interval consecutive finite steps.
-                finite = jnp.isfinite(gnorm)
-
-                def do_update(args):
-                    params, opt_state, grads = args
-                    updates, new_state = tx.update(grads, opt_state, params)
-                    return optax.apply_updates(params, updates), new_state
-
-                params, opt_state = jax.lax.cond(
-                    finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
-                )
-                growth_tracker = jnp.where(finite, growth_tracker + 1, 0)
-                grew = growth_tracker >= scaler_cfg.growth_interval
-                scale = jnp.where(
-                    finite,
-                    jnp.where(grew, scale * scaler_cfg.growth_factor, scale),
-                    scale * scaler_cfg.backoff_factor,
-                )
-                growth_tracker = jnp.where(grew, 0, growth_tracker)
-            else:
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+            params, opt_state, scale, growth_tracker, _ = scaled_optimizer_update(
+                tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
+            )
             # pin output layouts: keeps the ZeRO stage-1/2 replicated-params
             # invariant and the moment shardings stable under GSPMD propagation,
             # via in-program constraints so buffer donation stays usable

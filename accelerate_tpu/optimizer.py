@@ -26,6 +26,60 @@ from .state import AcceleratorState, GradientState
 from .utils.dataclasses import LossScaleKwargs
 
 
+def scaled_optimizer_update(tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg):
+    """The single grads→update state machine shared by the eager path
+    (``AcceleratedOptimizer._build_update_fn``) and the fused path
+    (``Accelerator.compiled_step``) so loss-scale semantics cannot drift.
+
+    ``grads`` must already be unscaled (divided by ``scale`` × accumulation
+    count) and clipped; ``gnorm`` is their global norm. GradScaler semantics
+    (reference optimizer.py:145-159 + torch GradScaler): skip the update when
+    ``gnorm`` is non-finite and back off the scale; grow the scale after
+    ``growth_interval`` consecutive finite steps. With ``scaler_cfg=None`` this
+    is a plain optax update.
+
+    Returns ``(params, opt_state, scale, growth_tracker, skipped)``.
+    """
+    import optax
+
+    if scaler_cfg is not None:
+        finite = jnp.isfinite(gnorm)
+
+        def do_update(args):
+            params, opt_state, grads = args
+            updates, new_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        params, opt_state = jax.lax.cond(
+            finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
+        )
+        growth_tracker = jnp.where(finite, growth_tracker + 1, 0)
+        grew = growth_tracker >= scaler_cfg.growth_interval
+        scale = jnp.where(
+            finite,
+            jnp.where(grew, scale * scaler_cfg.growth_factor, scale),
+            scale * scaler_cfg.backoff_factor,
+        )
+        growth_tracker = jnp.where(grew, 0, growth_tracker)
+        skipped = ~finite
+    else:
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        skipped = jnp.asarray(False)
+    return params, opt_state, scale, growth_tracker, skipped
+
+
+def clip_by_global_norm(grads, clip_norm):
+    """Global-norm clip shared by both update paths; returns (grads, gnorm)."""
+    import optax
+
+    gnorm = optax.global_norm(grads)
+    if clip_norm is not None:
+        factor = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * factor, grads)
+    return grads, gnorm
+
+
 class AcceleratedOptimizer:
     def __init__(
         self,
@@ -117,8 +171,6 @@ class AcceleratedOptimizer:
     # -- the update --------------------------------------------------------
 
     def _build_update_fn(self):
-        import optax
-
         clip_norm = self._pending_clip_norm
         use_scaler = self.scaler is not None
         scaler_cfg = self.scaler
@@ -126,38 +178,10 @@ class AcceleratedOptimizer:
         def update(params, opt_state, grads, accum_count, scale, growth_tracker):
             denom = accum_count.astype(jnp.float32) * (scale if use_scaler else jnp.float32(1.0))
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
-            if clip_norm is not None:
-                gnorm = optax.global_norm(grads)
-                factor = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * factor, grads)
-            else:
-                gnorm = optax.global_norm(grads)
-
-            if use_scaler:
-                finite = jnp.isfinite(gnorm)
-
-                def do_update(args):
-                    params, opt_state, grads = args
-                    updates, new_state = self.tx.update(grads, opt_state, params)
-                    return optax.apply_updates(params, updates), new_state
-
-                params, opt_state = jax.lax.cond(
-                    finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
-                )
-                # dynamic loss-scale bookkeeping (reference: GradScaler semantics)
-                growth_tracker = jnp.where(finite, growth_tracker + 1, 0)
-                grew = growth_tracker >= scaler_cfg.growth_interval
-                scale = jnp.where(
-                    finite,
-                    jnp.where(grew, scale * scaler_cfg.growth_factor, scale),
-                    scale * scaler_cfg.backoff_factor,
-                )
-                growth_tracker = jnp.where(grew, 0, growth_tracker)
-                skipped = ~finite
-            else:
-                updates, opt_state = self.tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                skipped = jnp.asarray(False)
+            grads, gnorm = clip_by_global_norm(grads, clip_norm)
+            params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
+                self.tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
+            )
             # pin output layouts: without this GSPMD propagates the fsdp
             # sharding of the moment buffers into the updated params (breaking
             # the ZeRO stage-1/2 "params replicated" invariant) or conversely

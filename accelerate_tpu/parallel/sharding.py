@@ -177,23 +177,40 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shardings_like(state_shapes: Any, params: Any, params_shardings: Any, mesh: Mesh) -> Any:
-    """Shardings for an optimizer-state tree: leaves whose shape matches a
-    param reuse that param's sharding (Adam moments); everything else is
+    """Shardings for an optimizer-state tree: leaves that are param-tree copies
+    (Adam moments) reuse the matching param's sharding; everything else is
     replicated (step counters, scalars).
 
     ``state_shapes`` is a tree of ShapeDtypeStructs from
-    ``jax.eval_shape(tx.init, params)``. Matching is by shape — exact for the
-    moment buffers optax keeps as param-tree copies, conservative (replicate)
-    for anything else.
+    ``jax.eval_shape(tx.init, params)``. Matching is by *tree path*: optax
+    embeds whole param-tree copies inside the state (``.../mu/layers/wq``), so
+    a state leaf matches the param whose path is the longest suffix of the
+    state leaf's path with an equal shape. Shape-only matching would silently
+    give two same-shaped params with different shardings the wrong moment
+    layout (first-match-wins); path matching cannot.
     """
-    lookup: dict[tuple, NamedSharding] = {}
-    for p_leaf, s_leaf in zip(jax.tree.leaves(params), jax.tree.leaves(params_shardings)):
-        lookup.setdefault(tuple(p_leaf.shape), s_leaf)
+    by_path: dict[str, tuple[tuple, NamedSharding]] = {}
 
-    def _leaf(leaf):
-        sharding = lookup.get(tuple(leaf.shape))
-        if sharding is not None and len(leaf.shape) > 0:
-            return sharding
+    def _collect(key_path, p_leaf, s_leaf):
+        by_path[param_path(key_path)] = (tuple(p_leaf.shape), s_leaf)
+        return p_leaf
+
+    jax.tree_util.tree_map_with_path(_collect, params, params_shardings)
+
+    def _leaf(key_path, leaf):
+        if len(leaf.shape) == 0:
+            return replicated(mesh)
+        path = param_path(key_path)
+        shape = tuple(leaf.shape)
+        best = None
+        for p_path, (p_shape, sharding) in by_path.items():
+            if p_shape != shape:
+                continue
+            if path == p_path or path.endswith("/" + p_path):
+                if best is None or len(p_path) > len(best[0]):
+                    best = (p_path, sharding)
+        if best is not None:
+            return best[1]
         return replicated(mesh)
 
-    return jax.tree.map(_leaf, state_shapes)
+    return jax.tree_util.tree_map_with_path(_leaf, state_shapes)
