@@ -10,6 +10,13 @@ __version__ = "0.1.0"
 
 from .state import AcceleratorState, GradientState, PartialState
 from .accelerator import Accelerator, PreparedModel
+from .big_modeling import (
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)
 from .data_loader import prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer

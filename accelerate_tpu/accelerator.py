@@ -662,7 +662,7 @@ class Accelerator:
             # unscale the reported loss with the scale it was computed under,
             # before the scaler bookkeeping below mutates `scale`
             loss = loss / scale
-            params, opt_state, scale, growth_tracker, _ = scaled_optimizer_update(
+            params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
                 tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
             )
             # pin output layouts: keeps the ZeRO stage-1/2 replicated-params
@@ -670,7 +670,7 @@ class Accelerator:
             # via in-program constraints so buffer donation stays usable
             params = jax.lax.with_sharding_constraint(params, model.params_shardings)
             opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
-            return params, opt_state, loss, scale, growth_tracker
+            return params, opt_state, loss, scale, growth_tracker, skipped
 
         jitted = jax.jit(step_impl, donate_argnums=(0, 1))
 
@@ -680,7 +680,7 @@ class Accelerator:
             opt_state_in = optimizer.opt_state
             if optimizer.cpu_offload:
                 opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)
-            params, opt_state, loss, scale, growth = jitted(
+            params, opt_state, loss, scale, growth, skipped = jitted(
                 model.params, opt_state_in, batch, scale, growth
             )
             model.params = params
@@ -689,6 +689,9 @@ class Accelerator:
                 optimizer.opt_state = jax.device_put(opt_state, optimizer._opt_state_shardings)
             if scaler_cfg is not None:
                 optimizer.scale, optimizer.growth_tracker = scale, growth
+            # lazy device scalar; step_was_skipped converts — so the scheduler
+            # sees overflow-skipped steps exactly as on the eager path
+            optimizer._skipped = skipped
             optimizer._step_count += 1
             return loss
 

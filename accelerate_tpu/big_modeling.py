@@ -354,17 +354,13 @@ def load_checkpoint_and_dispatch(
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
 ) -> StreamedCausalLM:
-    """Load weights (file/dir/shard-index) and dispatch (big_modeling.py:498)."""
-    from .checkpointing import load_model_weights
+    """Load weights and dispatch (big_modeling.py:498). Accepts both the
+    native flat layout ("layers/wq" stacked tensors) and HuggingFace/torch
+    llama layout ("model.layers.0.self_attn.q_proj.weight" …) — the latter is
+    translated (transpose + restack) by utils/hf_import.py."""
+    from .utils.hf_import import load_checkpoint_in_model
 
-    flat = load_model_weights(checkpoint)
-    # rebuild the nested structure the dispatcher expects
-    params: dict[str, Any] = {"layers": {}}
-    for key, value in flat.items():
-        if key.startswith("layers/"):
-            params["layers"][key.split("/", 1)[1]] = value
-        else:
-            params[key] = value
+    params = load_checkpoint_in_model(model, checkpoint)
     return dispatch_model(
         model, params, device_map=device_map, max_memory=max_memory, offload_dir=offload_dir, dtype=dtype
     )

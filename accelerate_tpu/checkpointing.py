@@ -16,9 +16,14 @@ RNG state is tiny because jax PRNG keys are values derived from (seed, count)
 — the whole per-device generator-state zoo of the reference (checkpointing.py:
 136-149) collapses to two integers plus the host RNGs.
 
-Model/optimizer arrays are gathered to host and written by process 0 (every
-array also lands back on its NamedSharding at load, so resuming on a different
-topology works). TODO(perf): per-host shard writing for >10B models.
+Model weights have two write paths:
+  * default — arrays gathered to host, process 0 writes (small/medium models);
+  * ``sharded=True`` — every process writes only the chunks it holds
+    (``save_model_weights_sharded``), so a model that only fits sharded can
+    still be checkpointed; the loader auto-detects the format via
+    ``is_sharded_checkpoint`` and reassembles across topologies.
+Either way every array lands back on its NamedSharding at load, so resuming
+on a different mesh works.
 """
 
 from __future__ import annotations
@@ -46,6 +51,8 @@ logger = get_logger(__name__)
 
 MODEL_FILE = "model_{i}.safetensors"
 OPTIMIZER_FILE = "optimizer_{i}.npz"
+OPTIMIZER_SHARDED_FILE = "optimizer_{i}.safetensors"
+OPTIMIZER_META_FILE = "optimizer_{i}.meta.json"
 SCHEDULER_FILE = "scheduler_{i}.json"
 SCALER_FILE = "scaler_{i}.json"
 RNG_FILE = "random_states_{p}.pkl"
@@ -342,7 +349,9 @@ def _list_checkpoints(base: str) -> list[str]:
     return [path for _, path in sorted(entries)]
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+def save_accelerator_state(
+    accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True, sharded: bool = False
+) -> str:
     state = PartialState()
     output_dir = _resolve_save_dir(accelerator, output_dir)
     os.makedirs(output_dir, exist_ok=True)
@@ -352,22 +361,40 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
         hook(accelerator._models, [], output_dir)
 
     for i, model in enumerate(accelerator._models):
-        save_model_weights(
-            model.params, output_dir, safe_serialization=safe_serialization, weights_name=MODEL_FILE.format(i=i)
-        )
+        if sharded:
+            save_model_weights_sharded(
+                model.params, output_dir, weights_name=MODEL_FILE.format(i=i), safe_serialization=safe_serialization
+            )
+        else:
+            save_model_weights(
+                model.params, output_dir, safe_serialization=safe_serialization, weights_name=MODEL_FILE.format(i=i)
+            )
     for i, optimizer in enumerate(accelerator._optimizers):
-        # to_numpy on sharded state is a collective — every host must run it;
-        # only the main process writes the result.
         sd = optimizer.state_dict()
-        leaves = jax.tree.leaves(sd["opt_state"])
-        arrays = {f"leaf_{j}": np.asarray(to_numpy(leaf)) for j, leaf in enumerate(leaves)}
-        if state.is_main_process:
-            meta = {"step_count": sd["step_count"]}
-            if "scale" in sd:
-                meta["scale"] = float(sd["scale"])
-                meta["growth_tracker"] = int(sd["growth_tracker"])
-            arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
-            np.savez(os.path.join(output_dir, OPTIMIZER_FILE.format(i=i)), **arrays)
+        meta = {"step_count": sd["step_count"]}
+        if "scale" in sd:
+            meta["scale"] = float(sd["scale"])
+            meta["growth_tracker"] = int(sd["growth_tracker"])
+        if sharded:
+            # optimizer moments are the largest sharded component under ZeRO —
+            # per-process chunk writing here too, no host gather
+            save_model_weights_sharded(
+                sd["opt_state"],
+                output_dir,
+                weights_name=OPTIMIZER_SHARDED_FILE.format(i=i),
+                safe_serialization=safe_serialization,
+            )
+            if state.is_main_process:
+                with open(os.path.join(output_dir, OPTIMIZER_META_FILE.format(i=i)), "w") as f:
+                    json.dump(meta, f)
+        else:
+            # to_numpy on sharded state is a collective — every host must run
+            # it; only the main process writes the result.
+            leaves = jax.tree.leaves(sd["opt_state"])
+            arrays = {f"leaf_{j}": np.asarray(to_numpy(leaf)) for j, leaf in enumerate(leaves)}
+            if state.is_main_process:
+                arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+                np.savez(os.path.join(output_dir, OPTIMIZER_FILE.format(i=i)), **arrays)
     if state.is_main_process:
         for i, scheduler in enumerate(accelerator._schedulers):
             with open(os.path.join(output_dir, SCHEDULER_FILE.format(i=i)), "w") as f:
@@ -402,17 +429,27 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kw
 
     for i, model in enumerate(accelerator._models):
         weights_name = MODEL_FILE.format(i=i)
-        index = os.path.join(input_dir, f"{weights_name}.index.json")
-        source = index if os.path.exists(index) else os.path.join(input_dir, weights_name)
-        flat = load_model_weights(source)
+        if is_sharded_checkpoint(input_dir, weights_name):
+            flat = load_model_weights_sharded(input_dir, weights_name)
+        else:
+            index = os.path.join(input_dir, f"{weights_name}.index.json")
+            source = index if os.path.exists(index) else os.path.join(input_dir, weights_name)
+            flat = load_model_weights(source)
         model.params = unflatten_into(model.params, flat, model.params_shardings)
     for i, optimizer in enumerate(accelerator._optimizers):
-        path = os.path.join(input_dir, OPTIMIZER_FILE.format(i=i))
-        with np.load(path, allow_pickle=False) as z:
-            meta = json.loads(bytes(z["__meta__"]).decode())
-            leaves = [z[f"leaf_{j}"] for j in range(len(z.files) - 1)]
-        treedef = jax.tree.structure(optimizer.opt_state)
-        sd = {"opt_state": jax.tree.unflatten(treedef, leaves), "step_count": meta["step_count"]}
+        if is_sharded_checkpoint(input_dir, OPTIMIZER_SHARDED_FILE.format(i=i)):
+            flat = load_model_weights_sharded(input_dir, OPTIMIZER_SHARDED_FILE.format(i=i))
+            opt_state = unflatten_into(optimizer.opt_state, flat)
+            with open(os.path.join(input_dir, OPTIMIZER_META_FILE.format(i=i))) as f:
+                meta = json.load(f)
+        else:
+            path = os.path.join(input_dir, OPTIMIZER_FILE.format(i=i))
+            with np.load(path, allow_pickle=False) as z:
+                meta = json.loads(bytes(z["__meta__"]).decode())
+                leaves = [z[f"leaf_{j}"] for j in range(len(z.files) - 1)]
+            treedef = jax.tree.structure(optimizer.opt_state)
+            opt_state = jax.tree.unflatten(treedef, leaves)
+        sd = {"opt_state": opt_state, "step_count": meta["step_count"]}
         if "scale" in meta:
             sd["scale"] = meta["scale"]
             sd["growth_tracker"] = meta["growth_tracker"]

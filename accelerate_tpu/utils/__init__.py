@@ -44,6 +44,12 @@ from .imports import (
     is_transformers_available,
     is_wandb_available,
 )
+from .hf_import import (
+    export_hf_llama,
+    import_hf_llama,
+    load_checkpoint_in_model,
+    load_hf_state_dict,
+)
 from .memory import find_executable_batch_size, release_memory, should_reduce_batch_size
 from .random import (
     next_rng_key,

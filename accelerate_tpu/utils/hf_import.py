@@ -76,12 +76,11 @@ def load_hf_state_dict(path: str) -> dict[str, np.ndarray]:
 
 
 def _load_one(path: str) -> dict[str, np.ndarray]:
-    if path.endswith(".safetensors"):
-        from safetensors.numpy import load_file
+    # shared reader (handles .safetensors, the .npz sibling written when
+    # safetensors is unavailable, and plain .npz)
+    from ..checkpointing import _load_flat
 
-        return load_file(path)
-    with np.load(path, allow_pickle=False) as z:
-        return {k: z[k] for k in z.files}
+    return _load_flat(path)
 
 
 def looks_like_hf_checkpoint(flat: dict) -> bool:
@@ -211,9 +210,27 @@ def load_checkpoint_in_model(model, checkpoint_path: str, dtype=None) -> dict:
     if looks_like_hf_checkpoint(flat):
         return import_hf_llama(flat, model.config, dtype=dtype)
     # native flat layout ("embed_tokens", "layers/wq", ...): unflatten by path
+    # against the abstract tree, keeping numpy leaves (no device allocation —
+    # the whole point of big-model loading)
     import jax
 
-    from ..checkpointing import unflatten_into
+    from ..parallel.sharding import param_path
 
     abstract = jax.eval_shape(model.init, jax.random.key(0))
-    return unflatten_into(abstract, flat)
+
+    def _pick(key_path, leaf):
+        path = param_path(key_path)
+        if path not in flat:
+            raise KeyError(f"checkpoint missing parameter {path!r}")
+        value = np.asarray(flat[path])
+        if value.shape != tuple(leaf.shape):
+            raise ValueError(
+                f"shape mismatch for {path}: checkpoint {value.shape} vs model {tuple(leaf.shape)}"
+            )
+        return value
+
+    params = jax.tree_util.tree_map_with_path(_pick, abstract)
+    if dtype is not None:
+        np_dtype = np.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+        params = _tree_astype(params, np_dtype)
+    return params

@@ -337,6 +337,10 @@ def test_compiled_step_fp16_applies_loss_scaling():
     np.testing.assert_allclose(float(after["a"]), float(params_snapshot["a"]))
     np.testing.assert_allclose(float(after["b"]), float(params_snapshot["b"]))
     assert float(optimizer.scale) < scale_before
+    # the fused path must surface the skip so the scheduler doesn't tick
+    assert optimizer.step_was_skipped
+    step(batch)
+    assert not optimizer.step_was_skipped
 
 
 def test_compiled_step_fp16_matches_eager_path():

@@ -1,0 +1,135 @@
+"""HF/torch checkpoint interop: import (transpose + restack), export (inverse),
+tied embeddings, and the load_checkpoint_and_dispatch route
+(reference utils/modeling.py:1541, 606-693)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models import Llama
+from accelerate_tpu.models.config import get_config
+from accelerate_tpu.utils.hf_import import (
+    export_hf_llama,
+    import_hf_llama,
+    load_checkpoint_in_model,
+    load_hf_state_dict,
+    looks_like_hf_checkpoint,
+)
+
+
+def _model(tie=False):
+    cfg = dataclasses.replace(get_config("llama-tiny"), tie_embeddings=tie)
+    return Llama(cfg)
+
+
+def _params(model, seed=0):
+    return jax.device_get(model.init(jax.random.key(seed)))
+
+
+def _save_hf(flat, directory):
+    from safetensors.numpy import save_file
+
+    save_file({k: np.ascontiguousarray(v) for k, v in flat.items()},
+              str(directory / "model.safetensors"))
+
+
+def test_export_import_roundtrip_exact():
+    """our tree → HF naming → back: bitwise equal (covers every transpose)."""
+    model = _model()
+    params = _params(model)
+    flat = export_hf_llama(params, model.config)
+    assert looks_like_hf_checkpoint(flat)
+    # HF naming and torch [out, in] orientation
+    cfg = model.config
+    assert flat["model.layers.0.self_attn.q_proj.weight"].shape == (
+        cfg.num_heads * cfg.dim_per_head,
+        cfg.hidden_size,
+    )
+    back = import_hf_llama(flat, model.config)
+    for key in ("embed_tokens", "final_norm", "lm_head"):
+        np.testing.assert_array_equal(back[key], np.asarray(params[key]))
+    for key, value in params["layers"].items():
+        np.testing.assert_array_equal(back["layers"][key], np.asarray(value))
+
+
+def test_import_forward_parity(tmp_path):
+    """Logits from an HF-layout checkpoint on disk match the source params."""
+    model = _model()
+    params = _params(model)
+    _save_hf(export_hf_llama(params, model.config), tmp_path)
+    imported = load_checkpoint_in_model(model, str(tmp_path))
+    tokens = jnp.asarray([[1, 5, 9, 2]], jnp.int32)
+    expected = model.apply(params, tokens)
+    got = model.apply(jax.tree.map(jnp.asarray, imported), tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+
+
+def test_tied_embedding_copy_is_dropped():
+    """torch ties by pointer; serialized that's an equal copy — drop it."""
+    model = _model(tie=True)
+    params = _params(model)
+    assert "lm_head" not in params
+    flat = export_hf_llama(params, model.config)
+    flat["lm_head.weight"] = np.asarray(params["embed_tokens"])  # [v, h], tied copy
+    back = import_hf_llama(flat, model.config)
+    assert "lm_head" not in back
+
+
+def test_tied_config_with_distinct_lm_head_raises():
+    model = _model(tie=True)
+    params = _params(model)
+    flat = export_hf_llama(params, model.config)
+    flat["lm_head.weight"] = np.random.default_rng(0).normal(
+        size=(model.config.vocab_size, model.config.hidden_size)
+    ).astype(np.float32)
+    with pytest.raises(ValueError, match="distinct lm_head"):
+        import_hf_llama(flat, model.config)
+
+
+def test_untied_config_missing_lm_head_raises():
+    model = _model(tie=False)
+    params = _params(model)
+    flat = export_hf_llama(params, model.config)
+    del flat["lm_head.weight"]
+    with pytest.raises(KeyError, match="tie_embeddings"):
+        import_hf_llama(flat, model.config)
+
+
+def test_wrong_config_shape_mismatch_raises():
+    model = _model()
+    params = _params(model)
+    flat = export_hf_llama(params, model.config)
+    small = dataclasses.replace(model.config, intermediate_size=model.config.intermediate_size * 2)
+    with pytest.raises(ValueError, match="shape"):
+        import_hf_llama(flat, small)
+
+
+def test_load_checkpoint_in_model_native_layout(tmp_path):
+    """Native flat layout still loads (numpy leaves, no device allocation)."""
+    from accelerate_tpu.checkpointing import save_model_weights
+
+    model = _model()
+    params = _params(model)
+    save_model_weights(params, str(tmp_path))
+    loaded = load_checkpoint_in_model(model, str(tmp_path))
+    leaves = jax.tree.leaves(loaded)
+    assert all(isinstance(l, np.ndarray) for l in leaves)
+    np.testing.assert_array_equal(loaded["embed_tokens"], np.asarray(params["embed_tokens"]))
+
+
+def test_load_checkpoint_and_dispatch_hf_layout(tmp_path):
+    """The big-model entry point accepts an HF-layout directory end to end."""
+    from accelerate_tpu import load_checkpoint_and_dispatch
+
+    model = _model()
+    params = _params(model)
+    _save_hf(export_hf_llama(params, model.config), tmp_path)
+    lm = load_checkpoint_and_dispatch(model, str(tmp_path), device_map="auto", dtype=jnp.float32)
+    tokens = jnp.asarray([[1, 5, 9, 2]], jnp.int32)
+    expected = model.apply(params, tokens)
+    got = lm(tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=1e-5)
