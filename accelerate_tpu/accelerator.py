@@ -412,13 +412,23 @@ class Accelerator:
         self._schedulers.append(scheduler)
         return scheduler
 
-    def prepare_data_loader(self, loader: Any, device_placement: Optional[bool] = None) -> BaseDataLoader:
+    def prepare_data_loader(self, loader: Any, device_placement: Optional[bool] = None, **loader_kwargs) -> BaseDataLoader:
+        """``loader_kwargs`` (batch_size, shuffle, seed, collate_fn, drop_last,
+        use_seedable_sampler) pass through to ``prepare_data_loader`` when a
+        raw dataset is handed in."""
+        if isinstance(loader, BaseDataLoader) and loader_kwargs:
+            raise ValueError(
+                "This loader is already prepared; the extra options "
+                f"{sorted(loader_kwargs)} would be silently ignored. Pass the "
+                "raw dataset instead to reconfigure it."
+            )
         prepared = prepare_data_loader(
             loader,
             device_placement=device_placement if device_placement is not None else self.device_placement,
             split_batches=self.split_batches,
             even_batches=self.even_batches,
             dispatch_batches=self.dispatch_batches,
+            **loader_kwargs,
         )
         self._dataloaders.append(prepared)
         return prepared

@@ -71,8 +71,15 @@ def flatten_params(params: Any) -> dict[str, np.ndarray]:
     return flat
 
 
-def unflatten_into(params: Any, flat: dict[str, np.ndarray], shardings: Any = None) -> Any:
-    """Place ``flat`` values into the structure of ``params`` (and shardings)."""
+def unflatten_into(
+    params: Any, flat: dict[str, np.ndarray], shardings: Any = None, materialize: str = "device"
+) -> Any:
+    """Place ``flat`` values into the structure of ``params`` (and shardings).
+
+    ``materialize="numpy"`` keeps host numpy leaves (no device allocation) —
+    for callers that device_put onto their own shardings later, so a tensor
+    that only fits sharded never exists replicated on one device.
+    """
 
     def _pick(key_path, leaf, sharding=None):
         path = param_path(key_path)
@@ -84,6 +91,8 @@ def unflatten_into(params: Any, flat: dict[str, np.ndarray], shardings: Any = No
         value = value.astype(leaf.dtype)
         if sharding is not None:
             return jax.device_put(value, sharding)
+        if materialize == "numpy":
+            return value
         return jnp.asarray(value)
 
     if shardings is not None:
@@ -266,6 +275,7 @@ def load_model_weights_sharded(
         chunk_files.update(index["chunks"])
 
     out: dict[str, np.ndarray] = {}
+    covered: dict[str, int] = {}
     by_file: dict[str, list[str]] = {}
     for key, fname in chunk_files.items():
         by_file.setdefault(fname, []).append(key)
@@ -279,12 +289,24 @@ def load_model_weights_sharded(
                 out[path] = np.empty(tuple(tensors[path]["shape"]), dtype=chunk.dtype)
             if chunk.ndim == 0:
                 out[path] = chunk
+                covered[path] = covered.get(path, 0) + 1
             else:
                 slices = tuple(slice(o, o + s) for o, s in zip(start, chunk.shape))
                 out[path][slices] = chunk
-    missing = set(tensors) - set(out)
-    if missing:
-        raise FileNotFoundError(f"Sharded checkpoint is missing chunks for: {sorted(missing)[:5]}")
+                covered[path] = covered.get(path, 0) + chunk.size
+    # chunks are disjoint by construction (replica 0 of each global slice),
+    # so full coverage ⇔ covered element count == tensor size. Catches a lost
+    # shard file whose tensors still appear in the surviving indexes.
+    incomplete = [
+        path
+        for path, meta in tensors.items()
+        if covered.get(path, 0) != max(int(np.prod(meta["shape"])), 1)
+    ]
+    if incomplete:
+        raise FileNotFoundError(
+            f"Sharded checkpoint has missing/incomplete chunks for: {sorted(incomplete)[:5]} "
+            f"— a shard file (and its .index.json) was likely lost"
+        )
     return out
 
 
@@ -349,6 +371,30 @@ def _list_checkpoints(base: str) -> list[str]:
     return [path for _, path in sorted(entries)]
 
 
+def _remove_stale_format(output_dir: str, sharded: bool, num_models: int, num_optimizers: int) -> None:
+    import glob as _glob
+
+    doomed: list[str] = []
+    for i in range(num_models):
+        base, _ = os.path.splitext(MODEL_FILE.format(i=i))
+        if sharded:
+            doomed += [os.path.join(output_dir, MODEL_FILE.format(i=i))]
+            doomed += _glob.glob(os.path.join(output_dir, f"{base}.npz"))
+            doomed += _glob.glob(os.path.join(output_dir, f"{MODEL_FILE.format(i=i)}.index.json"))
+        else:
+            doomed += _glob.glob(os.path.join(output_dir, f"{base}.shard*"))
+    for i in range(num_optimizers):
+        base, _ = os.path.splitext(OPTIMIZER_SHARDED_FILE.format(i=i))
+        if sharded:
+            doomed += [os.path.join(output_dir, OPTIMIZER_FILE.format(i=i))]
+        else:
+            doomed += _glob.glob(os.path.join(output_dir, f"{base}.shard*"))
+            doomed += [os.path.join(output_dir, OPTIMIZER_META_FILE.format(i=i))]
+    for path in doomed:
+        if os.path.exists(path):
+            os.remove(path)
+
+
 def save_accelerator_state(
     accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True, sharded: bool = False
 ) -> str:
@@ -359,6 +405,12 @@ def save_accelerator_state(
 
     for hook in accelerator._save_model_hooks:
         hook(accelerator._models, [], output_dir)
+
+    if state.is_main_process:
+        # saving into a reused directory must not leave the other format's
+        # files behind — the loader's auto-detection would restore stale state
+        _remove_stale_format(output_dir, sharded, len(accelerator._models), len(accelerator._optimizers))
+    state.wait_for_everyone()
 
     for i, model in enumerate(accelerator._models):
         if sharded:
@@ -439,7 +491,9 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kw
     for i, optimizer in enumerate(accelerator._optimizers):
         if is_sharded_checkpoint(input_dir, OPTIMIZER_SHARDED_FILE.format(i=i)):
             flat = load_model_weights_sharded(input_dir, OPTIMIZER_SHARDED_FILE.format(i=i))
-            opt_state = unflatten_into(optimizer.opt_state, flat)
+            # numpy leaves: load_state_dict device_puts straight onto the
+            # sharded layout, so full moments never sit replicated on one chip
+            opt_state = unflatten_into(optimizer.opt_state, flat, materialize="numpy")
             with open(os.path.join(input_dir, OPTIMIZER_META_FILE.format(i=i))) as f:
                 meta = json.load(f)
         else:

@@ -214,22 +214,10 @@ def load_checkpoint_in_model(model, checkpoint_path: str, dtype=None) -> dict:
     # the whole point of big-model loading)
     import jax
 
-    from ..parallel.sharding import param_path
+    from ..checkpointing import unflatten_into
 
     abstract = jax.eval_shape(model.init, jax.random.key(0))
-
-    def _pick(key_path, leaf):
-        path = param_path(key_path)
-        if path not in flat:
-            raise KeyError(f"checkpoint missing parameter {path!r}")
-        value = np.asarray(flat[path])
-        if value.shape != tuple(leaf.shape):
-            raise ValueError(
-                f"shape mismatch for {path}: checkpoint {value.shape} vs model {tuple(leaf.shape)}"
-            )
-        return value
-
-    params = jax.tree_util.tree_map_with_path(_pick, abstract)
+    params = unflatten_into(abstract, flat, materialize="numpy")
     if dtype is not None:
         np_dtype = np.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
         params = _tree_astype(params, np_dtype)

@@ -1,0 +1,75 @@
+"""Feature example: gradient accumulation.
+
+Parity: reference examples/by_feature/gradient_accumulation.py — pass
+``gradient_accumulation_steps=N`` to ``Accelerator`` and wrap the step in
+``accumulate()``; the optimizer/scheduler only advance on the Nth micro-step.
+
+On TPU there is additionally a fused fast path: ``accelerator.compiled_step``
+folds the whole accumulation window into one jit program (``lax.scan`` over
+microbatches) — shown at the bottom.
+
+Run:
+    python examples/by_feature/gradient_accumulation.py --gradient_accumulation_steps 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Gradient accumulation example.")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator(gradient_accumulation_steps=args.gradient_accumulation_steps)
+    set_seed(42)
+
+    model = Bert("bert-tiny")
+    dataset = PairClassificationDataset(vocab_size=model.config.vocab_size, max_len=64)
+    model, optimizer, train_loader = accelerator.prepare(
+        model,
+        optax.adamw(args.lr),
+        accelerator.prepare_data_loader(dataset, batch_size=args.batch_size, shuffle=True, seed=42),
+    )
+    loss_fn = Bert.loss_fn(accelerator.unwrap_model(model))
+
+    for epoch in range(args.num_epochs):
+        train_loader.set_epoch(epoch)
+        for batch in train_loader:
+            # inside accumulate(), optimizer.step()/zero_grad() are no-ops
+            # until the window closes — the loop body stays identical
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(loss_fn, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.print(
+            f"epoch {epoch}: loss={float(loss):.4f} optimizer_steps={optimizer.step_count}"
+        )
+
+    # --- fused alternative: one compiled program per optimizer step ---------
+    # The batch's leading dim is split into gradient_accumulation_steps
+    # microbatches inside jit; no Python between micro-steps.
+    step = accelerator.compiled_step(loss_fn)
+    big_batch = next(iter(train_loader))  # leading dim divisible by the window
+    loss = step(big_batch)
+    accelerator.print(f"fused accumulation step: loss={float(loss):.4f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Feature example: experiment tracking.
+
+Parity: reference examples/by_feature/tracking.py — ``log_with=...`` on the
+Accelerator, ``init_trackers`` with the run config, ``accelerator.log`` per
+step (main process only), ``end_training`` to flush.
+
+The JSONL tracker needs no external service, so this runs anywhere; swap
+``--log_with tensorboard`` (or wandb/mlflow/comet/aim) when those backends
+are configured.
+
+Run:
+    python examples/by_feature/tracking.py --project_dir /tmp/run1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset, accuracy_f1
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Tracking example.")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--log_with", type=str, default="jsonl")
+    parser.add_argument("--project_dir", type=str, required=True)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator(
+        log_with=args.log_with,
+        project_config=ProjectConfiguration(project_dir=args.project_dir, logging_dir=args.project_dir),
+    )
+    config = {"lr": args.lr, "num_epochs": args.num_epochs, "batch_size": args.batch_size, "seed": 42}
+    accelerator.init_trackers("nlp_example", config)
+    set_seed(42)
+
+    model = Bert("bert-tiny")
+    dataset = PairClassificationDataset(vocab_size=model.config.vocab_size, max_len=64)
+    model, optimizer, train_loader = accelerator.prepare(
+        model,
+        optax.adamw(args.lr),
+        accelerator.prepare_data_loader(dataset, batch_size=args.batch_size, shuffle=True, seed=42),
+    )
+    loss_fn = Bert.loss_fn(accelerator.unwrap_model(model))
+
+    global_step = 0
+    for epoch in range(args.num_epochs):
+        train_loader.set_epoch(epoch)
+        epoch_loss = 0.0
+        for batch in train_loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(loss_fn, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+            epoch_loss += float(loss)
+            accelerator.log({"train_loss": float(loss)}, step=global_step)
+            global_step += 1
+
+        predictions, references = [], []
+        for batch in train_loader:
+            logits = model.apply(
+                model.params, batch["input_ids"], batch["attention_mask"], batch["token_type_ids"]
+            )
+            preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["labels"]))
+            predictions.append(np.asarray(preds))
+            references.append(np.asarray(refs))
+        metric = accuracy_f1(np.concatenate(predictions), np.concatenate(references))
+        accelerator.log(
+            {"epoch_loss": epoch_loss / len(train_loader), **metric}, step=global_step
+        )
+        accelerator.print(f"epoch {epoch}: {metric}")
+
+    accelerator.end_training()  # flushes/closes every tracker
+
+
+if __name__ == "__main__":
+    main()

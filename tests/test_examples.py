@@ -1,0 +1,68 @@
+"""Every example must run green on the virtual mesh (reference
+tests/test_examples.py:41-43 — tiny bundled data, subprocess execution)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def run_example(path, *args, timeout=420):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, path), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    assert result.returncode == 0, f"{path} failed:\n{result.stdout}\n{result.stderr}"
+    return result.stdout
+
+
+def test_nlp_example():
+    out = run_example("nlp_example.py", "--num_epochs", "1")
+    assert re.search(r"epoch 0: \{'accuracy': [\d.]+, 'f1': [\d.]+\}", out)
+
+
+def test_gradient_accumulation_example():
+    out = run_example("by_feature/gradient_accumulation.py", "--num_epochs", "1")
+    # 48 samples / batch 8 = 6 batches with a 4-batch window → one full window
+    # plus the end-of-epoch partial sync = exactly 2 optimizer steps
+    assert "optimizer_steps=2" in out
+    assert "fused accumulation step" in out
+
+
+def test_checkpointing_example_resume(tmp_path):
+    out = run_example(
+        "by_feature/checkpointing.py", "--checkpoint_dir", str(tmp_path), "--num_epochs", "1"
+    )
+    assert "saved epoch_0" in out
+    assert os.path.exists(tmp_path / "epoch_0" / "model_0.safetensors")
+    out = run_example(
+        "by_feature/checkpointing.py",
+        "--checkpoint_dir", str(tmp_path),
+        "--num_epochs", "2",
+        "--resume_from_checkpoint", "epoch_0",
+    )
+    assert "resumed from epoch_0 at epoch 1" in out
+    assert "saved epoch_1" in out
+
+
+def test_tracking_example(tmp_path):
+    import json
+
+    out = run_example("by_feature/tracking.py", "--project_dir", str(tmp_path), "--num_epochs", "1")
+    assert re.search(r"epoch 0: \{'accuracy': [\d.]+", out)
+    metrics_file = tmp_path / "nlp_example" / "metrics.jsonl"
+    assert metrics_file.exists()
+    lines = [json.loads(l) for l in metrics_file.read_text().splitlines()]
+    assert lines[0]["_config"]["num_epochs"] == 1
+    assert any("train_loss" in l for l in lines)
+    assert any("accuracy" in l for l in lines)
