@@ -178,13 +178,14 @@ class Accelerator:
             and fsdp_plugin.activation_checkpointing
             and self.compilation_config.remat_policy is None
         ):
-            # FSDP plugin activation checkpointing ≙ remat everything but matmul
-            # outputs (reference accelerator.py:1450-1464 applies torch
-            # checkpoint wrappers post-wrap; here it is a jax.checkpoint policy).
+            # FSDP plugin activation checkpointing ≙ full recompute inside each
+            # layer (Megatron recompute_activations semantics; reference
+            # accelerator.py:1450-1464 applies torch checkpoint wrappers
+            # post-wrap). Scan models apply this per layer (prepare_model).
             # Copy: the config object is caller-owned and may be shared.
             import dataclasses as _dc
 
-            self.compilation_config = _dc.replace(self.compilation_config, remat_policy="dots_saveable")
+            self.compilation_config = _dc.replace(self.compilation_config, remat_policy="full")
 
         if self.state.mixed_precision == "fp16" and self.loss_scale_kwargs is None:
             self.loss_scale_kwargs = LossScaleKwargs()
@@ -367,6 +368,14 @@ class Accelerator:
                 else self.mesh.shape[MESH_AXIS_PIPELINE]
             )
             model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
+        layer_policy = self.compilation_config.checkpoint_policy()
+        if layer_policy is not None and hasattr(model, "remat_layers"):
+            # scan-structured models apply the remat policy per layer (the
+            # scan carry is always saved; the policy decides what survives
+            # inside a layer) instead of the outer loss-fn wrap, which for
+            # dot-saving policies would keep every attention score across all
+            # layers alive at once
+            model.remat_layers = layer_policy
         prepared = PreparedModel(model, ParamBox(params), shardings, self.state.precision_policy)
         self._models.append(prepared)
         return prepared
@@ -490,7 +499,12 @@ class Accelerator:
         key = (loss_fn, id(model), has_aux)
         if key not in self._grad_fns:
             policy = self.state.precision_policy
-            remat_policy = self.compilation_config.checkpoint_policy()
+            # models with built-in per-layer remat don't get the outer wrap
+            remat_policy = (
+                None
+                if getattr(model.module, "remat_layers", False)
+                else self.compilation_config.checkpoint_policy()
+            )
 
             def scaled_loss(params, batch, scale):
                 compute_params = cast_floating(params, policy.compute_dtype)
@@ -640,7 +654,12 @@ class Accelerator:
         policy = self.state.precision_policy
         num_micro = self.gradient_state.num_steps
         tx = optimizer.tx
-        remat_policy = self.compilation_config.checkpoint_policy()
+        # models with built-in per-layer remat don't get the outer wrap
+        remat_policy = (
+            None
+            if getattr(model.module, "remat_layers", False)
+            else self.compilation_config.checkpoint_policy()
+        )
         scaler_cfg = optimizer.scaler  # fp16 dynamic loss scaling (None otherwise)
 
         def loss_of(params, batch, scale):

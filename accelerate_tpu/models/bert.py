@@ -34,6 +34,8 @@ class Bert:
     def __init__(self, config: TransformerConfig | str):
         self.config = get_config(config) if isinstance(config, str) else config
         assert self.config.arch == "bert"
+        # per-layer activation checkpointing (see models/llama.py)
+        self.remat_layers = False
 
     def init(self, rng: jax.Array) -> dict:
         if not hasattr(self, "_init_jit"):
@@ -150,7 +152,12 @@ class Bert:
             return h, None
 
         xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-        h, _ = jax.lax.scan(layer, h, xs)
+        body = (
+            jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+            if self.remat_layers
+            else layer
+        )
+        h, _ = jax.lax.scan(body, h, xs)
         pooled = jnp.tanh(h[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
         return pooled @ params["classifier"]["w"] + params["classifier"]["b"]
 

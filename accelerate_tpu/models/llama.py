@@ -105,6 +105,12 @@ class Llama:
         # axis (ring attention) or a pipeline axis (GPipe layer schedule).
         self.attention_fn = None
         self.pipeline_fn = None
+        # Per-layer activation checkpointing, set by Accelerator.prepare_model:
+        # falsy = off; a jax.checkpoint policy callable (or True for
+        # save-nothing) decides what survives inside each scanned layer — the
+        # carried layer input is always saved, so save-nothing gives Megatron
+        # "recompute_activations" semantics.
+        self.remat_layers = False
 
     # -- parameters --------------------------------------------------------
 
@@ -209,7 +215,12 @@ class Llama:
             h = self.pipeline_fn(params["layers"], h, cos, sin, mask)
         else:
             xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-            h, _ = jax.lax.scan(layer, h, xs)
+            body = (
+                jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+                if self.remat_layers
+                else layer
+            )
+            h, _ = jax.lax.scan(body, h, xs)
         h = rms_norm(h, params["final_norm"], cfg.norm_eps)
         head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
         logits = h @ head.astype(h.dtype)

@@ -137,7 +137,7 @@ def test_cpu_offload_keeps_opt_state_on_host():
 def test_activation_checkpointing_sets_remat_policy():
     plugin = FullyShardedDataParallelPlugin(activation_checkpointing=True)
     acc = Accelerator(parallelism=ParallelismConfig(fsdp=8), fsdp_plugin=plugin)
-    assert acc.compilation_config.remat_policy == "dots_saveable"
+    assert acc.compilation_config.remat_policy == "full"
     assert acc.compilation_config.checkpoint_policy() is not None
     # and training still runs through the remat path
     model = acc.prepare(BigLinear())
@@ -174,6 +174,42 @@ def test_adjust_scheduler_advances_on_accumulation_steps():
                 opt.zero_grad()
         data_extent = 8  # default mesh: all devices on the data axis
         assert sched.step_count == expected_extra + data_extent
+
+
+def test_activation_checkpointing_uses_per_layer_remat_for_scan_models():
+    """Scan-structured models remat per layer (attention internals recomputed,
+    not saved) and the post-step parameters — i.e. the gradients — match the
+    no-remat run exactly."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    results = {}
+    for ckpt in (False, True):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        from accelerate_tpu.utils import set_seed
+
+        set_seed(0)
+        plugin = FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=ckpt)
+        acc = Accelerator(parallelism=ParallelismConfig(fsdp=8), fsdp_plugin=plugin)
+        model = Llama("llama-tiny")
+        prepared = acc.prepare(model)
+        if ckpt:
+            assert callable(model.remat_layers)  # the policy threads through
+        else:
+            assert model.remat_layers is False
+        import optax
+
+        acc.prepare_optimizer(optax.sgd(0.1))
+        opt = acc._optimizers[-1]
+        batch = {"x": jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % 100}
+        acc.backward(lambda p, b: Llama.loss_fn(model)(p, {"input_ids": b["x"]}), batch)
+        opt.step()
+        results[ckpt] = jax.device_get(prepared.params)
+    for got, want in zip(jax.tree.leaves(results[True]), jax.tree.leaves(results[False])):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-7)
 
 
 def test_stage2_llama_with_tp_keeps_tp_sharding():
