@@ -369,13 +369,19 @@ class Accelerator:
             )
             model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
         layer_policy = self.compilation_config.checkpoint_policy()
-        if layer_policy is not None and hasattr(model, "remat_layers"):
+        if hasattr(model, "remat_layers"):
             # scan-structured models apply the remat policy per layer (the
             # scan carry is always saved; the policy decides what survives
             # inside a layer) instead of the outer loss-fn wrap, which for
             # dot-saving policies would keep every attention score across all
-            # layers alive at once
-            model.remat_layers = layer_policy
+            # layers alive at once. The pipeline branch bypasses the scan, so
+            # those models keep the outer wrap. Always assign — the model
+            # object may be re-prepared under a different Accelerator config.
+            model.remat_layers = (
+                layer_policy
+                if layer_policy is not None and getattr(model, "pipeline_fn", None) is None
+                else False
+            )
         prepared = PreparedModel(model, ParamBox(params), shardings, self.state.precision_policy)
         self._models.append(prepared)
         return prepared
@@ -493,18 +499,20 @@ class Accelerator:
 
     _GRAD_FN_CACHE_LIMIT = 16
 
+    def _effective_remat_policy(self, model: PreparedModel):
+        """Models with built-in per-layer remat don't get the outer loss-fn
+        jax.checkpoint wrap (it would re-save what the layers already handle)."""
+        if getattr(model.module, "remat_layers", False):
+            return None
+        return self.compilation_config.checkpoint_policy()
+
     def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel, has_aux: bool) -> Callable:
         # key holds a strong reference to loss_fn: ids of collected objects are
         # reused, so an id()-only key could serve a stale compiled grad fn.
         key = (loss_fn, id(model), has_aux)
         if key not in self._grad_fns:
             policy = self.state.precision_policy
-            # models with built-in per-layer remat don't get the outer wrap
-            remat_policy = (
-                None
-                if getattr(model.module, "remat_layers", False)
-                else self.compilation_config.checkpoint_policy()
-            )
+            remat_policy = self._effective_remat_policy(model)
 
             def scaled_loss(params, batch, scale):
                 compute_params = cast_floating(params, policy.compute_dtype)
@@ -654,12 +662,7 @@ class Accelerator:
         policy = self.state.precision_policy
         num_micro = self.gradient_state.num_steps
         tx = optimizer.tx
-        # models with built-in per-layer remat don't get the outer wrap
-        remat_policy = (
-            None
-            if getattr(model.module, "remat_layers", False)
-            else self.compilation_config.checkpoint_policy()
-        )
+        remat_policy = self._effective_remat_policy(model)
         scaler_cfg = optimizer.scaler  # fp16 dynamic loss scaling (None otherwise)
 
         def loss_of(params, batch, scale):

@@ -37,11 +37,12 @@ from .logging import get_logger
 from .models.attention import rotary_embedding
 from .models.config import TransformerConfig
 from .models.llama import Llama, decoder_layer, rms_norm
-from .utils.modeling import check_device_map, infer_auto_device_map
+from .utils.modeling import _iter_flat as _flat_items, check_device_map, infer_auto_device_map
 from .utils.offload import load_offloaded_weight, offload_weight, save_offload_index
 
 logger = get_logger(__name__)
 
+# kept for llama HF-name mapping stability; the packer itself is generic
 LAYER_KEYS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate", "w_up", "w_down")
 
 
@@ -57,47 +58,65 @@ def init_empty_weights(model) -> Any:
 init_on_device = init_empty_weights  # parity alias
 
 
-class LayerPacker:
-    """Fixed layout of one decoder layer in a single contiguous buffer."""
+def _unflatten(flat: dict[str, Any]) -> dict:
+    out: dict = {}
+    for key, value in flat.items():
+        node = out
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
 
-    def __init__(self, cfg: TransformerConfig, dtype):
-        h, i = cfg.hidden_size, cfg.intermediate_size
-        nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+
+class LayerPacker:
+    """Fixed layout of one transformer layer in a single contiguous buffer.
+
+    Works on ANY stacked-layers pytree (leaves shaped [L, ...]): the layout
+    is derived from the tree itself, not from a model family (reference
+    hooks.py:212 works on arbitrary modules — this is the analogue). Ordering
+    is the sorted flattened key order, identical on pack and unpack.
+    """
+
+    def __init__(self, stacked_layers: Any, dtype):
         self.dtype = dtype
-        self.shapes = {
-            "attn_norm": (h,),
-            "wq": (h, nh * d),
-            "wk": (h, nkv * d),
-            "wv": (h, nkv * d),
-            "wo": (nh * d, h),
-            "mlp_norm": (h,),
-            "w_gate": (h, i),
-            "w_up": (h, i),
-            "w_down": (i, h),
+        self.shapes: dict[str, tuple] = {
+            key: tuple(leaf.shape[1:]) for key, leaf in _flat_items(stacked_layers)
         }
-        self.offsets = {}
+        self.offsets: dict[str, tuple[int, int]] = {}
         offset = 0
-        for key in LAYER_KEYS:
-            size = int(np.prod(self.shapes[key]))
+        for key, shape in self.shapes.items():
+            size = int(np.prod(shape)) if shape else 1
             self.offsets[key] = (offset, size)
             offset += size
         self.total = offset
 
+    @classmethod
+    def for_config(cls, cfg: TransformerConfig, dtype) -> "LayerPacker":
+        """Layout from a llama config without materializing params (bench)."""
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+        shapes = {
+            "attn_norm": (1, h), "mlp_norm": (1, h),
+            "wq": (1, h, nh * d), "wk": (1, h, nkv * d), "wv": (1, h, nkv * d),
+            "wo": (1, nh * d, h), "w_gate": (1, h, i), "w_up": (1, h, i), "w_down": (1, i, h),
+        }
+        return cls({k: np.empty(s, np.int8) for k, s in shapes.items()}, dtype)
+
     def pack(self, layer: Mapping[str, Any]) -> np.ndarray:
         np_dtype = np.asarray(jnp.zeros((), self.dtype)).dtype
         buf = np.empty((self.total,), np_dtype)
-        for key in LAYER_KEYS:
-            offset, size = self.offsets[key]
-            buf[offset : offset + size] = np.asarray(layer[key], np_dtype).ravel()
+        flat = dict(_flat_items(layer))
+        for key, (offset, size) in self.offsets.items():
+            buf[offset : offset + size] = np.asarray(flat[key], np_dtype).ravel()
         return buf
 
     def unpack(self, buf: jax.Array) -> dict[str, jax.Array]:
         """On-device view extraction (static slices; used inside jit)."""
         out = {}
-        for key in LAYER_KEYS:
-            offset, size = self.offsets[key]
+        for key, (offset, size) in self.offsets.items():
             out[key] = buf[offset : offset + size].reshape(self.shapes[key])
-        return out
+        return _unflatten(out)
 
 
 class StreamedCausalLM:
@@ -256,52 +275,105 @@ class StreamedCausalLM:
         return np.concatenate([np.asarray(t) for t in tokens], axis=1)
 
 
-def dispatch_model(
-    model: Llama,
-    params: Any,
-    device_map: dict[str, str] | str = "auto",
-    max_memory: Optional[dict] = None,
-    offload_dir: Optional[str] = None,
-    dtype=jnp.bfloat16,
-) -> StreamedCausalLM:
-    """Place components per ``device_map`` and return the streaming executor.
+class StreamedModel:
+    """Generic streaming executor for any model exposing the stream protocol:
 
-    Parity: reference dispatch_model (big_modeling.py:305) + hook attachment.
+    - ``stream_prefix(resident, *args, **kwargs) -> carry`` (a pytree)
+    - ``stream_layer(carry, layer_params) -> carry``
+    - ``stream_suffix(resident, carry) -> output``
+
+    where ``resident`` is the param tree minus ``layers``. The per-layer
+    compute is ONE jit program reused by every layer; non-resident layers
+    stream through HBM with the async double buffer. This replaces the
+    reference's forward-patched AlignDevicesHook on arbitrary modules
+    (hooks.py:212-382) without touching the model's code.
     """
-    cfg = model.config
-    dtype_bytes = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
-    if isinstance(device_map, str):
-        device_map = infer_auto_device_map(model, max_memory=max_memory, dtype_bytes=dtype_bytes)
-    check_device_map(model, device_map)
+
+    def __init__(self, model, resident_flat, layer_buffers, layer_on_device, packer, dtype):
+        self.model = model
+        self.config = getattr(model, "config", None)
+        self._resident_flat = resident_flat
+        self.layer_buffers = layer_buffers
+        self.layer_on_device = layer_on_device
+        self.packer = packer
+        self.dtype = dtype
+        self.hf_device_map: dict[str, str] = {}
+        self._layer_fn = None
+
+    def _put(self, buf) -> jax.Array:
+        return jax.device_put(jnp.asarray(buf))
+
+    def resident_tree(self) -> dict:
+        """Nested resident params, streaming host/disk leaves to the device."""
+        return _unflatten(
+            {
+                key: value if isinstance(value, jax.Array) else self._put(np.asarray(value))
+                for key, value in self._resident_flat.items()
+            }
+        )
+
+    def _iter_device_layers(self):
+        L = len(self.layer_buffers)
+        next_buf = None
+        for i in range(L):
+            if self.layer_on_device[i]:
+                current = self.layer_buffers[i]
+            else:
+                current = next_buf if next_buf is not None else self._put(self.layer_buffers[i])
+            next_buf = None
+            j = i + 1
+            if j < L and not self.layer_on_device[j]:
+                next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
+            yield current
+
+    def __call__(self, *args, **kwargs):
+        resident = self.resident_tree()
+        carry = self.model.stream_prefix(resident, *args, **kwargs)
+        if self._layer_fn is None:
+            unpack, stream_layer = self.packer.unpack, self.model.stream_layer
+
+            @jax.jit
+            def layer_fn(carry, buf):
+                return stream_layer(carry, unpack(buf))
+
+            self._layer_fn = layer_fn
+        for buf in self._iter_device_layers():
+            carry = self._layer_fn(carry, buf)
+        return self.model.stream_suffix(resident, carry)
+
+
+def _place_components(params, device_map, offload_dir, dtype):
+    """Shared placement: resident leaves + packed per-layer buffers."""
+    np_dtype = np.asarray(jnp.zeros((), dtype)).dtype
 
     resident: dict[str, Any] = {}
-    np_dtype = np.asarray(jnp.zeros((), dtype)).dtype
-    for key in ("embed_tokens", "final_norm", "lm_head"):
-        if key in params:
-            target = device_map.get(key, "device")
-            host = np.asarray(params[key], np_dtype)
-            if target == "device":
-                resident[key] = jax.device_put(jnp.asarray(host))
-            elif target == "cpu":
-                resident[key] = host
-            elif target == "disk":
-                if offload_dir is None:
-                    raise ValueError(f"device_map places {key} on disk — pass offload_dir")
-                os.makedirs(offload_dir, exist_ok=True)
-                disk_meta = offload_weight(host, key, offload_dir, {})
-                resident[key] = load_offloaded_weight(
-                    os.path.join(offload_dir, f"{key}.dat"), disk_meta[key]
-                )
-            else:
-                raise ValueError(f"Unknown target {target!r} for {key}")
+    for key, leaf in _flat_items({k: v for k, v in params.items() if k != "layers"}):
+        target = device_map.get(key.replace("/", "."), "device")
+        host = np.asarray(leaf, np_dtype)
+        if target == "device":
+            resident[key] = jax.device_put(jnp.asarray(host))
+        elif target == "cpu":
+            resident[key] = host
+        elif target == "disk":
+            if offload_dir is None:
+                raise ValueError(f"device_map places {key} on disk — pass offload_dir")
+            os.makedirs(offload_dir, exist_ok=True)
+            disk_name = key.replace("/", ".")
+            disk_meta = offload_weight(host, disk_name, offload_dir, {})
+            resident[key] = load_offloaded_weight(
+                os.path.join(offload_dir, f"{disk_name}.dat"), disk_meta[disk_name]
+            )
+        else:
+            raise ValueError(f"Unknown target {target!r} for {key}")
 
-    packer = LayerPacker(cfg, dtype)
-    stacked = {k: np.asarray(v) for k, v in params["layers"].items()}
+    packer = LayerPacker(params["layers"], dtype)
+    stacked = {k: np.asarray(v) for k, v in _flat_items(params["layers"])}
+    num_layers = next(iter(stacked.values())).shape[0]
     layer_buffers: list[Any] = []
     layer_on_device: list[bool] = []
     disk_index: dict = {}
-    for i in range(cfg.num_layers):
-        layer = {k: stacked[k][i] for k in LAYER_KEYS}
+    for i in range(num_layers):
+        layer = {k: v[i] for k, v in stacked.items()}
         target = device_map.get(f"layers.{i}", "device")
         packed = packer.pack(layer)
         if target == "device":
@@ -324,26 +396,64 @@ def dispatch_model(
             raise ValueError(f"Unknown target {target!r} for layers.{i}")
     if disk_index:
         save_offload_index(disk_index, offload_dir)
+    return resident, packer, layer_buffers, layer_on_device
 
-    dispatched = StreamedCausalLM(model, resident, layer_buffers, layer_on_device, packer, dtype=dtype)
+
+def dispatch_model(
+    model: Any,
+    params: Any,
+    device_map: dict[str, str] | str = "auto",
+    max_memory: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+    dtype=jnp.bfloat16,
+):
+    """Place components per ``device_map`` and return the streaming executor.
+
+    Parity: reference dispatch_model (big_modeling.py:305) + hook attachment.
+    Llama-family models get ``StreamedCausalLM`` (adds KV-cache ``generate``);
+    any other model implementing the stream protocol (``stream_prefix`` /
+    ``stream_layer`` / ``stream_suffix``) gets the generic ``StreamedModel``.
+    """
+    if not isinstance(model, Llama) and not hasattr(model, "stream_layer"):
+        raise TypeError(
+            f"{type(model).__name__} cannot be dispatched: implement the stream "
+            "protocol (stream_prefix/stream_layer/stream_suffix) or use a "
+            "llama-family model."
+        )
+    dtype_bytes = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
+    if isinstance(device_map, str):
+        device_map = infer_auto_device_map(model, max_memory=max_memory, dtype_bytes=dtype_bytes)
+    check_device_map(model, device_map)
+
+    resident, packer, layer_buffers, layer_on_device = _place_components(
+        params, device_map, offload_dir, dtype
+    )
+
+    if isinstance(model, Llama):
+        dispatched = StreamedCausalLM(model, resident, layer_buffers, layer_on_device, packer, dtype=dtype)
+    else:
+        dispatched = StreamedModel(model, resident, layer_buffers, layer_on_device, packer, dtype)
     dispatched.hf_device_map = dict(device_map)
     return dispatched
 
 
-def cpu_offload(model: Llama, params: Any, dtype=jnp.bfloat16) -> StreamedCausalLM:
+def _offload_map(model, layer_target: str) -> dict[str, str]:
+    from .utils.modeling import named_component_sizes
+
+    return {
+        key: (layer_target if key.startswith("layers.") else "device")
+        for key in named_component_sizes(model)
+    }
+
+
+def cpu_offload(model: Any, params: Any, dtype=jnp.bfloat16):
     """Everything streamed from host RAM (reference big_modeling.py:169)."""
-    cfg = model.config
-    device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
-    device_map.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
-    return dispatch_model(model, params, device_map, dtype=dtype)
+    return dispatch_model(model, params, _offload_map(model, "cpu"), dtype=dtype)
 
 
-def disk_offload(model: Llama, params: Any, offload_dir: str, dtype=jnp.bfloat16) -> StreamedCausalLM:
+def disk_offload(model: Any, params: Any, offload_dir: str, dtype=jnp.bfloat16):
     """Everything streamed from disk memmaps (reference big_modeling.py:249)."""
-    cfg = model.config
-    device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
-    device_map.update({f"layers.{i}": "disk" for i in range(cfg.num_layers)})
-    return dispatch_model(model, params, device_map, offload_dir=offload_dir, dtype=dtype)
+    return dispatch_model(model, params, _offload_map(model, "disk"), offload_dir=offload_dir, dtype=dtype)
 
 
 def load_checkpoint_and_dispatch(

@@ -161,6 +161,50 @@ class Bert:
         pooled = jnp.tanh(h[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
         return pooled @ params["classifier"]["w"] + params["classifier"]["b"]
 
+    # -- streaming protocol (big-model dispatch, big_modeling.StreamedModel) --
+
+    def stream_prefix(self, resident, input_ids, attention_mask=None, token_type_ids=None):
+        """Embeddings → (hidden, mask) carry for the per-layer stream."""
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        emb = resident["embeddings"]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (
+            jnp.take(emb["word"], input_ids, axis=0)
+            + jnp.take(emb["position"], jnp.arange(s)[None, :], axis=0)
+            + jnp.take(emb["token_type"], jnp.asarray(token_type_ids, jnp.int32), axis=0)
+        )
+        h = layer_norm(h, emb["norm_scale"], emb["norm_bias"], cfg.norm_eps)
+        mask = None
+        if attention_mask is not None:
+            mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
+        return (h, mask)
+
+    def stream_layer(self, carry, lp):
+        """One encoder layer; identical math to the scan body in ``apply``."""
+        cfg = self.config
+        h, mask = carry
+        b, s, _ = h.shape
+        nh = cfg.num_heads
+        d = cfg.hidden_size // nh
+        q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, nh, d)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(b, s, nh, d)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(b, s, nh, d)
+        attn = dot_product_attention(q, k, v, mask=mask)
+        attn_out = attn.reshape(b, s, nh * d) @ lp["wo"] + lp["bo"]
+        h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+        up = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
+        mlp_out = up @ lp["w_down"] + lp["b_down"]
+        h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
+        return (h, mask)
+
+    def stream_suffix(self, resident, carry):
+        h, _ = carry
+        pooled = jnp.tanh(h[:, 0] @ resident["pooler"]["w"] + resident["pooler"]["b"])
+        return pooled @ resident["classifier"]["w"] + resident["classifier"]["b"]
+
     @staticmethod
     def loss_fn(model: "Bert"):
         """Softmax CE over {input_ids, attention_mask?, token_type_ids?, labels}."""

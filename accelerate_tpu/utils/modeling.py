@@ -51,9 +51,12 @@ def named_component_sizes(model, dtype_bytes: int = 4) -> dict[str, int]:
 
 
 def _iter_flat(tree, prefix=""):
+    """Depth-first (key, leaf) pairs with '/'-joined keys, sorted per level —
+    the canonical component-key order shared by device maps and the layer
+    packer (big_modeling)."""
     if isinstance(tree, Mapping):
-        for k, v in tree.items():
-            yield from _iter_flat(v, f"{prefix}{k}/")
+        for k in sorted(tree):
+            yield from _iter_flat(tree[k], f"{prefix}{k}/")
     else:
         yield prefix[:-1], tree
 

@@ -32,9 +32,9 @@ import time
 
 import numpy as np
 
-# last recorded steps/sec/chip on the driver's TPU (BENCH_r02.json); the gate
-# only engages on TPU — CPU numbers are not comparable
-PERF_FLOOR_TPU = 31.16
+# last recorded steps/sec/chip, keyed by chip generation (the number is only
+# comparable on the hardware it was measured on — BENCH_r02.json, v5e)
+PERF_FLOORS = {"v5e": 31.16}
 
 # peak dense matmul throughput per chip, bf16 (for MFU). Sources: public TPU
 # spec sheets; "fallback" covers unknown TPU generations conservatively.
@@ -235,7 +235,7 @@ def bench_big_model_inference() -> dict:
     if "peak_bytes_in_use" in stats_after:
         # invariant: HBM never held the whole offloaded stack — bound peak by
         # resident components + a small multiple of the packed layer buffer
-        packer = LayerPacker(model.config, jnp.bfloat16)
+        packer = LayerPacker.for_config(model.config, jnp.bfloat16)
         resident = sum(int(np.prod(v.shape)) * 2 for v in lm.resident.values())
         layer_bytes = packer.total * 2
         budget = stats_before.get("peak_bytes_in_use", 0) + resident + 4 * layer_bytes + (64 << 20)
@@ -258,7 +258,7 @@ def main() -> None:
             errors[fn.__name__] = f"{type(e).__name__}: {e}"
 
     value = primary["bert_train_steps_per_sec_per_chip"]
-    on_tpu = jax.devices()[0].platform == "tpu"
+    device = jax.devices()[0]
     payload = {
         "metric": "bert-base MRPC-shaped train steps/sec/chip (bs=32, seq=128, bf16, adamw)",
         "value": value,
@@ -266,9 +266,12 @@ def main() -> None:
         "vs_baseline": None,  # reference publishes no training numbers (BASELINE.json published:{})
         "extra": extra,
     }
-    if on_tpu:
-        payload["floor"] = PERF_FLOOR_TPU
-        payload["regression"] = bool(value < 0.9 * PERF_FLOOR_TPU)
+    if device.platform == "tpu":
+        kind = getattr(device, "device_kind", "").lower()
+        floor = next((f for key, f in PERF_FLOORS.items() if key in kind), None)
+        if floor is not None:
+            payload["floor"] = floor
+            payload["regression"] = bool(value < 0.9 * floor)
     if errors:
         payload["errors"] = errors
     print(json.dumps(payload))

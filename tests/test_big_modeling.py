@@ -1,5 +1,6 @@
 """Big-model inference tests (reference tests/test_big_modeling.py, 1017 LoC):
-abstract init, auto device maps, dispatch/offload equivalence, generation."""
+abstract init, auto device maps, dispatch/offload equivalence, generation,
+and the generic stream protocol (arbitrary-model dispatch, hooks.py:212)."""
 
 import numpy as np
 import pytest
@@ -135,3 +136,54 @@ def test_streamed_generate_matches_generate(tiny):
     streamed = cpu_offload(model, params, dtype=jnp.float32)
     got = streamed.generate(ids, max_new_tokens=4)
     np.testing.assert_array_equal(got, expected)
+
+
+# -- generic (non-llama) dispatch via the stream protocol --------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    from accelerate_tpu.models import Bert
+
+    model = Bert("bert-tiny")
+    params = model.init(jax.random.key(1))
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 1024, (2, 10)), jnp.int32)
+    mask = jnp.asarray([[1] * 10, [1] * 7 + [0] * 3], jnp.int32)
+    types = jnp.asarray(rng.integers(0, 2, (2, 10)), jnp.int32)
+    full = model.apply(params, ids, mask, types)
+    return model, params, (ids, mask, types), full
+
+
+def test_dispatch_bert_all_device(tiny_bert):
+    """A model the module never special-cased dispatches via the protocol."""
+    model, params, inputs, full = tiny_bert
+    sizes = named_component_sizes(model)
+    device_map = {k: "device" for k in sizes}
+    streamed = dispatch_model(model, params, device_map, dtype=jnp.float32)
+    got = streamed(*inputs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full), atol=1e-5)
+
+
+def test_cpu_offload_bert_matches_full(tiny_bert):
+    model, params, inputs, full = tiny_bert
+    streamed = cpu_offload(model, params, dtype=jnp.float32)
+    got = streamed(*inputs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full), atol=1e-5)
+    # offloaded: every layer buffer lives on host
+    assert not any(streamed.layer_on_device)
+
+
+def test_disk_offload_bert_matches_full(tiny_bert, tmp_path):
+    model, params, inputs, full = tiny_bert
+    streamed = disk_offload(model, params, str(tmp_path), dtype=jnp.float32)
+    got = streamed(*inputs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full), atol=1e-5)
+
+
+def test_dispatch_unsupported_model_raises():
+    class NotStreamable:
+        pass
+
+    with pytest.raises(TypeError, match="stream"):
+        dispatch_model(NotStreamable(), {"layers": {"w": np.zeros((2, 4))}}, {"layers.0": "device", "layers.1": "device"})

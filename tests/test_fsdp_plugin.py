@@ -212,6 +212,35 @@ def test_activation_checkpointing_uses_per_layer_remat_for_scan_models():
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-7)
 
 
+def test_pipeline_models_keep_outer_remat_wrap():
+    """Pipeline bypasses the layer scan, so activation checkpointing must fall
+    back to the outer loss-fn wrap — not silently disappear."""
+    plugin = FullyShardedDataParallelPlugin(activation_checkpointing=True)
+    acc = Accelerator(parallelism=ParallelismConfig(fsdp=2, pipeline=2, tensor=2), fsdp_plugin=plugin)
+    model = Llama("llama-tiny")
+    prepared = acc.prepare_model(model)
+    assert model.pipeline_fn is not None
+    assert model.remat_layers is False
+    assert acc._effective_remat_policy(prepared) is not None
+
+
+def test_reprepare_without_checkpointing_resets_remat():
+    """remat_layers must not leak across Accelerator configs sharing a model."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    model = Llama("llama-tiny")
+    plugin = FullyShardedDataParallelPlugin(activation_checkpointing=True)
+    acc = Accelerator(parallelism=ParallelismConfig(fsdp=8), fsdp_plugin=plugin)
+    acc.prepare_model(model)
+    assert callable(model.remat_layers)
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = Accelerator(parallelism=ParallelismConfig(fsdp=8))
+    acc2.prepare_model(model)
+    assert model.remat_layers is False
+
+
 def test_stage2_llama_with_tp_keeps_tp_sharding():
     """Stage 1/2 must not strip the explicit TP rules, only the fsdp fold."""
     plugin = FullyShardedDataParallelPlugin(stage=2, min_weight_size=0)
