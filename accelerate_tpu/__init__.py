@@ -20,6 +20,7 @@ from .big_modeling import (
 from .data_loader import prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
+from .parallel.local_sgd import LocalSGD
 from .scheduler import AcceleratedScheduler
 from . import ops
 from .utils import (

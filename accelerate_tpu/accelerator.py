@@ -353,21 +353,30 @@ class Accelerator:
             params = shard_tree(params, shardings)
         from .utils.constants import MESH_AXIS_PIPELINE, MESH_AXIS_SEQUENCE
 
-        if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1 and hasattr(model, "attention_fn"):
-            # sequence axis active: swap in exact ring attention so K/V blocks
-            # rotate over ICI instead of being all-gathered
-            from .parallel.ring_attention import make_ring_attention
+        # Assign (or clear) the mesh-dependent hooks unconditionally: the model
+        # object may be re-prepared under a different Accelerator/mesh, and a
+        # stale pipeline_fn/attention_fn closes over the old mesh.
+        if hasattr(model, "attention_fn"):
+            if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
+                # sequence axis active: swap in exact ring attention so K/V
+                # blocks rotate over ICI instead of being all-gathered
+                from .parallel.ring_attention import make_ring_attention
 
-            model.attention_fn = make_ring_attention(self.mesh)
-        if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1 and hasattr(model, "pipeline_fn"):
-            from .parallel.pipeline import make_pipeline_layers_fn
+                model.attention_fn = make_ring_attention(self.mesh)
+            else:
+                model.attention_fn = None
+        if hasattr(model, "pipeline_fn"):
+            if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1:
+                from .parallel.pipeline import make_pipeline_layers_fn
 
-            num_micro = (
-                self.model_parallel_plugin.num_microbatches
-                if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
-                else self.mesh.shape[MESH_AXIS_PIPELINE]
-            )
-            model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
+                num_micro = (
+                    self.model_parallel_plugin.num_microbatches
+                    if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
+                    else self.mesh.shape[MESH_AXIS_PIPELINE]
+                )
+                model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
+            else:
+                model.pipeline_fn = None
         layer_policy = self.compilation_config.checkpoint_policy()
         if hasattr(model, "remat_layers"):
             # scan-structured models apply the remat policy per layer (the

@@ -119,45 +119,22 @@ class LayerPacker:
         return _unflatten(out)
 
 
-class StreamedCausalLM:
-    """A llama-family model whose layers may live on device, host RAM, or disk.
-
-    ``__call__`` and ``generate`` stream non-resident layers through the
-    device with an async double buffer (device_put of layer i+1 is issued
+class _LayerStreamer:
+    """Shared streaming machinery: packed layer buffers on device/host/disk,
+    iterated with an async double buffer (device_put of layer i+1 is issued
     before layer i's compute is awaited — the H2D copy rides DMA while the
-    MXU works).
-    """
+    MXU works)."""
 
-    def __init__(
-        self,
-        model: Llama,
-        resident: dict[str, jax.Array],
-        layer_buffers: list[Any],  # packed 1D host buffers (np/memmap) or device arrays
-        layer_on_device: list[bool],
-        packer: LayerPacker,
-        dtype=jnp.bfloat16,
-    ):
+    def __init__(self, model, layer_buffers, layer_on_device, packer: LayerPacker, dtype):
         self.model = model
-        self.config: TransformerConfig = model.config
-        self.resident = resident
-        self.layer_buffers = layer_buffers
+        self.layer_buffers = layer_buffers  # packed 1D host buffers (np/memmap) or device arrays
         self.layer_on_device = layer_on_device
         self.packer = packer
         self.dtype = dtype
         self.hf_device_map: dict[str, str] = {}
-        self._layer_fn = None
-        self._cached_layer_fn = None
 
     def _put(self, buf) -> jax.Array:
         return jax.device_put(jnp.asarray(buf))  # single contiguous DMA
-
-    def _resident(self, key: str) -> jax.Array:
-        """Fetch a non-layer component, streaming it if device_map kept it on
-        host/disk (embed/head can legitimately spill on tight budgets)."""
-        value = self.resident[key]
-        if isinstance(value, jax.Array):
-            return value
-        return self._put(np.asarray(value))
 
     def _iter_device_layers(self):
         """Yield each layer's packed device buffer, double-buffering transfers."""
@@ -173,6 +150,37 @@ class StreamedCausalLM:
             if j < L and not self.layer_on_device[j]:
                 next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
             yield current
+
+
+class StreamedCausalLM(_LayerStreamer):
+    """A llama-family model whose layers may live on device, host RAM, or disk.
+
+    Adds the KV-cache ``generate`` decode loop on top of the shared streaming
+    base.
+    """
+
+    def __init__(
+        self,
+        model: Llama,
+        resident: dict[str, jax.Array],
+        layer_buffers: list[Any],
+        layer_on_device: list[bool],
+        packer: LayerPacker,
+        dtype=jnp.bfloat16,
+    ):
+        super().__init__(model, layer_buffers, layer_on_device, packer, dtype)
+        self.config: TransformerConfig = model.config
+        self.resident = resident
+        self._layer_fn = None
+        self._cached_layer_fn = None
+
+    def _resident(self, key: str) -> jax.Array:
+        """Fetch a non-layer component, streaming it if device_map kept it on
+        host/disk (embed/head can legitimately spill on tight budgets)."""
+        value = self.resident[key]
+        if isinstance(value, jax.Array):
+            return value
+        return self._put(np.asarray(value))
 
     def _get_layer_fn(self):
         if self._layer_fn is None:
@@ -275,7 +283,7 @@ class StreamedCausalLM:
         return np.concatenate([np.asarray(t) for t in tokens], axis=1)
 
 
-class StreamedModel:
+class StreamedModel(_LayerStreamer):
     """Generic streaming executor for any model exposing the stream protocol:
 
     - ``stream_prefix(resident, *args, **kwargs) -> carry`` (a pytree)
@@ -290,18 +298,10 @@ class StreamedModel:
     """
 
     def __init__(self, model, resident_flat, layer_buffers, layer_on_device, packer, dtype):
-        self.model = model
+        super().__init__(model, layer_buffers, layer_on_device, packer, dtype)
         self.config = getattr(model, "config", None)
         self._resident_flat = resident_flat
-        self.layer_buffers = layer_buffers
-        self.layer_on_device = layer_on_device
-        self.packer = packer
-        self.dtype = dtype
-        self.hf_device_map: dict[str, str] = {}
         self._layer_fn = None
-
-    def _put(self, buf) -> jax.Array:
-        return jax.device_put(jnp.asarray(buf))
 
     def resident_tree(self) -> dict:
         """Nested resident params, streaming host/disk leaves to the device."""
@@ -311,20 +311,6 @@ class StreamedModel:
                 for key, value in self._resident_flat.items()
             }
         )
-
-    def _iter_device_layers(self):
-        L = len(self.layer_buffers)
-        next_buf = None
-        for i in range(L):
-            if self.layer_on_device[i]:
-                current = self.layer_buffers[i]
-            else:
-                current = next_buf if next_buf is not None else self._put(self.layer_buffers[i])
-            next_buf = None
-            j = i + 1
-            if j < L and not self.layer_on_device[j]:
-                next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
-            yield current
 
     def __call__(self, *args, **kwargs):
         resident = self.resident_tree()

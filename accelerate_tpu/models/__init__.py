@@ -2,6 +2,7 @@ from .attention import dot_product_attention, rotary_embedding
 from .bert import Bert
 from .config import TransformerConfig, get_config, list_models, param_count, register_config
 from .llama import Llama
+from .moe import MoEBlock
 
 
 _ARCHS = {"llama": Llama, "bert": Bert}

@@ -7,3 +7,4 @@ from .sharding import (
     shard_tree,
     shardings_like,
 )
+from .local_sgd import LocalSGD

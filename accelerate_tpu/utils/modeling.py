@@ -128,9 +128,12 @@ def infer_auto_device_map(
     budget["device"] = max(budget.get("device", 0) - 2 * largest_layer, 0)
 
     device_map: dict[str, str] = {}
-    order = ["embed_tokens"] + [k for k in sizes if k.startswith("layers.")] + [
-        k for k in sizes if not k.startswith("layers.") and k != "embed_tokens"
-    ]
+    # resident (non-layer) components first — they run on every forward — then
+    # layers in index order (numeric: "layers.10" after "layers.2")
+    layer_keys = sorted(
+        (k for k in sizes if k.startswith("layers.")), key=lambda k: int(k.split(".")[1])
+    )
+    order = sorted(k for k in sizes if not k.startswith("layers.")) + layer_keys
     targets = ["device", "cpu", "disk"]
     t = 0
     for key in order:

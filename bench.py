@@ -32,9 +32,10 @@ import time
 
 import numpy as np
 
-# last recorded steps/sec/chip, keyed by chip generation (the number is only
-# comparable on the hardware it was measured on — BENCH_r02.json, v5e)
-PERF_FLOORS = {"v5e": 31.16}
+# last recorded steps/sec/chip, keyed by chip generation substrings (the
+# number is only comparable on the hardware it was measured on — BENCH_r02,
+# v5e; JAX reports that device_kind as "TPU v5 lite" or "TPU v5e")
+PERF_FLOORS = {"v5e": 31.16, "v5 lite": 31.16, "v5litepod": 31.16}
 
 # peak dense matmul throughput per chip, bf16 (for MFU). Sources: public TPU
 # spec sheets; "fallback" covers unknown TPU generations conservatively.
@@ -272,6 +273,8 @@ def main() -> None:
         if floor is not None:
             payload["floor"] = floor
             payload["regression"] = bool(value < 0.9 * floor)
+        else:  # unmatched generation: surface it rather than silently skip
+            payload["floor_unmatched_device_kind"] = kind
     if errors:
         payload["errors"] = errors
     print(json.dumps(payload))

@@ -57,8 +57,9 @@ def test_infer_auto_device_map_spills_in_order(tiny):
     model, *_ = tiny
     sizes = named_component_sizes(model, dtype_bytes=2)
     largest = max(v for k, v in sizes.items() if k.startswith("layers."))
-    # budget: embed + layer0 + double-buffer headroom only
-    budget = sizes["embed_tokens"] + sizes["layers.0"] + 2 * largest + 1
+    resident = sum(v for k, v in sizes.items() if not k.startswith("layers."))
+    # budget: resident components + layer0 + double-buffer headroom only
+    budget = resident + sizes["layers.0"] + 2 * largest + 1
     device_map = infer_auto_device_map(model, max_memory={"device": budget, "cpu": 10**9})
     assert device_map["embed_tokens"] == "device"
     assert device_map["layers.0"] == "device"
@@ -187,3 +188,11 @@ def test_dispatch_unsupported_model_raises():
 
     with pytest.raises(TypeError, match="stream"):
         dispatch_model(NotStreamable(), {"layers": {"w": np.zeros((2, 4))}}, {"layers.0": "device", "layers.1": "device"})
+
+
+def test_auto_device_map_for_generic_model(tiny_bert):
+    """device_map='auto' must work for the generic protocol too."""
+    model, params, inputs, full = tiny_bert
+    streamed = dispatch_model(model, params, device_map="auto", dtype=jnp.float32)
+    got = streamed(*inputs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full), atol=1e-5)

@@ -252,3 +252,21 @@ def test_stage2_llama_with_tp_keeps_tp_sharding():
     flat = [ax for axes in wq_spec if axes is not None for ax in (axes if isinstance(axes, tuple) else (axes,))]
     assert "tensor" in flat
     assert "fsdp" not in flat
+
+
+def test_reprepare_without_pipeline_clears_stale_pipeline_fn():
+    """A pipeline_fn built on an old mesh must not survive re-preparation."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    model = Llama("llama-tiny")
+    acc = Accelerator(parallelism=ParallelismConfig(fsdp=2, pipeline=2, tensor=2))
+    acc.prepare_model(model)
+    assert model.pipeline_fn is not None
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    plugin = FullyShardedDataParallelPlugin(activation_checkpointing=True)
+    acc2 = Accelerator(parallelism=ParallelismConfig(fsdp=8), fsdp_plugin=plugin)
+    acc2.prepare_model(model)
+    assert model.pipeline_fn is None
+    assert callable(model.remat_layers)  # per-layer remat re-engages
