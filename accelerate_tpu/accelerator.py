@@ -365,6 +365,22 @@ class Accelerator:
                 model.attention_fn = make_ring_attention(self.mesh)
             else:
                 model.attention_fn = None
+        if self.state.mixed_precision == "fp8":
+            # fp8 = e4m3 per-tensor-scaled projection matmuls (ops/fp8). A
+            # model without the dot_fn hook cannot honor it — fail loudly
+            # instead of silently training in bf16.
+            if not hasattr(model, "dot_fn"):
+                raise NotImplementedError(
+                    f"mixed_precision='fp8' needs a model with fp8-capable "
+                    f"projections (a `dot_fn` hook, like the model zoo's "
+                    f"Llama/Bert); {type(model).__name__} has none. Use 'bf16' "
+                    "or add the hook."
+                )
+            from .ops.fp8 import fp8_dot
+
+            model.dot_fn = fp8_dot
+        elif hasattr(model, "dot_fn"):
+            model.dot_fn = None
         if hasattr(model, "pipeline_fn"):
             if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1:
                 from .parallel.pipeline import make_pipeline_layers_fn
@@ -374,7 +390,9 @@ class Accelerator:
                     if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
                     else self.mesh.shape[MESH_AXIS_PIPELINE]
                 )
-                model.pipeline_fn = make_pipeline_layers_fn(model.config, self.mesh, num_micro)
+                model.pipeline_fn = make_pipeline_layers_fn(
+                    model.config, self.mesh, num_micro, dot_fn=getattr(model, "dot_fn", None)
+                )
             else:
                 model.pipeline_fn = None
         layer_policy = self.compilation_config.checkpoint_policy()

@@ -187,9 +187,11 @@ class StreamedCausalLM(_LayerStreamer):
             cfg = self.config
             unpack = self.packer.unpack
 
+            dot_fn = getattr(self.model, "dot_fn", None)
+
             @jax.jit
             def layer_fn(h, buf, cos, sin, mask):
-                h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True)
+                h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True, dot_fn=dot_fn)
                 return h
 
             self._layer_fn = layer_fn
@@ -222,11 +224,14 @@ class StreamedCausalLM(_LayerStreamer):
             cfg = self.config
             unpack = self.packer.unpack
 
+            dot_fn = getattr(self.model, "dot_fn", None)
+
             @jax.jit
             def fn(h, buf, cache, length, cos, sin, mask):
                 h, new_cache = decoder_layer(
                     cfg, h, unpack(buf), cos, sin, mask,
                     cache={"k": cache["k"], "v": cache["v"], "length": length},
+                    dot_fn=dot_fn,
                 )
                 return h, {"k": new_cache["k"], "v": new_cache["v"]}
 

@@ -36,6 +36,8 @@ class Bert:
         assert self.config.arch == "bert"
         # per-layer activation checkpointing (see models/llama.py)
         self.remat_layers = False
+        # fp8 projection compute (ops/fp8.fp8_dot), set by prepare_model
+        self.dot_fn = None
 
     def init(self, rng: jax.Array) -> dict:
         if not hasattr(self, "_init_jit"):
@@ -133,19 +135,21 @@ class Bert:
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
 
+        dot = self.dot_fn if self.dot_fn is not None else (lambda a, w: a @ w)
+
         def layer(h, xs):
             lp = xs[0] if use_dropout else xs
             rngs = xs[1] if use_dropout else (None, None)
-            q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, nh, d)
-            k = (h @ lp["wk"] + lp["bk"]).reshape(b, s, nh, d)
-            v = (h @ lp["wv"] + lp["bv"]).reshape(b, s, nh, d)
+            q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
+            k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
+            v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
             attn = dot_product_attention(q, k, v, mask=mask)
-            attn_out = attn.reshape(b, s, nh * d) @ lp["wo"] + lp["bo"]
+            attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
             if use_dropout:
                 attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
             h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
-            up = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
-            mlp_out = up @ lp["w_down"] + lp["b_down"]
+            up = jax.nn.gelu(dot(h, lp["w_up"]) + lp["b_up"])
+            mlp_out = dot(up, lp["w_down"]) + lp["b_down"]
             if use_dropout:
                 mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
             h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
@@ -183,20 +187,22 @@ class Bert:
         return (h, mask)
 
     def stream_layer(self, carry, lp):
-        """One encoder layer; identical math to the scan body in ``apply``."""
+        """One encoder layer; identical math to the scan body in ``apply``
+        (including the dot_fn hook, so fp8 dispatch matches fp8 training)."""
         cfg = self.config
+        dot = self.dot_fn if self.dot_fn is not None else (lambda a, w: a @ w)
         h, mask = carry
         b, s, _ = h.shape
         nh = cfg.num_heads
         d = cfg.hidden_size // nh
-        q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, nh, d)
-        k = (h @ lp["wk"] + lp["bk"]).reshape(b, s, nh, d)
-        v = (h @ lp["wv"] + lp["bv"]).reshape(b, s, nh, d)
+        q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
+        k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
+        v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
         attn = dot_product_attention(q, k, v, mask=mask)
-        attn_out = attn.reshape(b, s, nh * d) @ lp["wo"] + lp["bo"]
+        attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
         h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
-        up = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
-        mlp_out = up @ lp["w_down"] + lp["b_down"]
+        up = jax.nn.gelu(dot(h, lp["w_up"]) + lp["b_up"])
+        mlp_out = dot(up, lp["w_down"]) + lp["b_down"]
         h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
         return (h, mask)
 

@@ -56,6 +56,7 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
         h, new_cache = decoder_layer(
             cfg, h, lp, cos, sin, mask,
             cache={"k": k_cache, "v": v_cache, "length": length},
+            dot_fn=getattr(model, "dot_fn", None),
         )
         return h, (new_cache["k"], new_cache["v"])
 
@@ -68,14 +69,16 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
 
 
 def _jit_for(model: Llama, name: str, build):
-    """Per-model jit cache so repeated generate() calls reuse compilations."""
+    """Per-model jit cache so repeated generate() calls reuse compilations.
+    Keyed on the model's dot_fn too — swapping fp8 on/off must recompile."""
     cache = getattr(model, "_jit_cache", None)
     if cache is None:
         cache = {}
         model._jit_cache = cache
-    if name not in cache:
-        cache[name] = build()
-    return cache[name]
+    key = (name, id(getattr(model, "dot_fn", None)))
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
 
 
 def generate(

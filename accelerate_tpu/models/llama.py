@@ -58,18 +58,20 @@ def decoder_layer(
     dropout_rate: float = 0.0,
     attention_fn=None,  # e.g. ring attention for sequence-sharded activations
     kv_mask=None,  # raw [B, S] validity mask for attention_fn implementations
+    dot_fn=None,  # e.g. ops.fp8.fp8_dot for fp8 projection compute
 ):
     """The one llama decoder layer used by every execution path (training
     scan, KV-cache decode, streamed big-model inference). Returns
     (h, updated_cache_or_None)."""
     from .attention import dropout  # local import to avoid cycle at module load
 
+    dot = dot_fn if dot_fn is not None else (lambda a, b: a @ b)
     b, s = h.shape[:2]
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
     x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-    q = (x @ lp["wq"]).reshape(b, s, nh, d)
-    k = (x @ lp["wk"]).reshape(b, s, nkv, d)
-    v = (x @ lp["wv"]).reshape(b, s, nkv, d)
+    q = dot(x, lp["wq"]).reshape(b, s, nh, d)
+    k = dot(x, lp["wk"]).reshape(b, s, nkv, d)
+    v = dot(x, lp["wv"]).reshape(b, s, nkv, d)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
     new_cache = None
@@ -82,13 +84,13 @@ def decoder_layer(
         attn = attention_fn(q, k, v, kv_mask)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=causal)
-    attn_out = attn.reshape(b, s, nh * d) @ lp["wo"]
+    attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"])
     if dropout_rngs[0] is not None:
         attn_out = dropout(attn_out, dropout_rate, dropout_rngs[0])
     h = h + attn_out
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
-    mlp_out = gated @ lp["w_down"]
+    gated = jax.nn.silu(dot(x, lp["w_gate"])) * dot(x, lp["w_up"])
+    mlp_out = dot(gated, lp["w_down"])
     if dropout_rngs[1] is not None:
         mlp_out = dropout(mlp_out, dropout_rate, dropout_rngs[1])
     h = h + mlp_out
@@ -105,6 +107,9 @@ class Llama:
         # axis (ring attention) or a pipeline axis (GPipe layer schedule).
         self.attention_fn = None
         self.pipeline_fn = None
+        # fp8 projection compute (ops/fp8.fp8_dot), set by prepare_model when
+        # mixed_precision="fp8"; None = plain matmul in the compute dtype.
+        self.dot_fn = None
         # Per-layer activation checkpointing, set by Accelerator.prepare_model:
         # falsy = off; a jax.checkpoint policy callable (or True for
         # save-nothing) decides what survives inside each scanned layer — the
@@ -205,6 +210,7 @@ class Llama:
                 cfg, h, lp, cos, sin, mask, causal=True,
                 dropout_rngs=rngs, dropout_rate=cfg.dropout_rate,
                 attention_fn=self.attention_fn, kv_mask=attention_mask,
+                dot_fn=self.dot_fn,
             )
             h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
             return h, None

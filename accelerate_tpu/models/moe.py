@@ -132,10 +132,14 @@ class MoEBlock:
 
 
 def _constrain_expert(value: jax.Array) -> jax.Array:
-    """Pin the leading expert dim to the expert mesh axis when inside jit."""
-    try:
-        from jax.sharding import PartitionSpec as P
+    """Pin the leading expert dim to the expert mesh axis when inside jit.
 
-        return jax.lax.with_sharding_constraint(value, P(MESH_AXIS_EXPERT, *([None] * (value.ndim - 1))))
-    except (ValueError, RuntimeError):
-        return value  # outside a mesh context (plain eager use)
+    Mesh presence is checked explicitly (not try/except) so that a genuine
+    sharding error — e.g. num_experts not divisible by the expert axis —
+    surfaces instead of silently dropping the constraint."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or MESH_AXIS_EXPERT not in mesh.axis_names:
+        return value  # plain eager use outside any mesh
+    return jax.lax.with_sharding_constraint(value, P(MESH_AXIS_EXPERT, *([None] * (value.ndim - 1))))

@@ -1,0 +1,43 @@
+"""fp8 (e4m3) matmul compute with per-tensor scaling.
+
+Parity: the reference converts nn.Linear to TransformerEngine layers under
+``fp8_autocast`` with a scaling recipe (utils/transformer_engine.py:24-72,
+accelerator.py:1360-1374). XLA has native float8_e4m3fn, so the TPU shape of
+the same capability is a scaled-quantize → fp8 ``dot_general`` (fp32
+accumulation) → dequantize, swapped into the model zoo's projections via the
+``dot_fn`` hook (set by ``Accelerator.prepare_model`` when
+``mixed_precision="fp8"``).
+
+Scaling is *current-tensor* (TE "current scaling"): each operand is scaled by
+its own abs-max to the e4m3 dynamic range at every call. Gradients flow
+straight through the casts (XLA's convert_element_type transpose), so this
+trains — the backward matmuls themselves stay in the compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0  # largest finite float8_e4m3fn value
+
+
+def quantize_e4m3(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-tensor scale to the e4m3 range; returns (quantized, scale)."""
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(amax, 1e-12) / E4M3_MAX
+    return (x / scale).astype(jnp.float8_e4m3fn), scale
+
+
+def fp8_dot(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x @ w`` with both operands in scaled e4m3, accumulating in fp32.
+
+    ``x``: [..., K], ``w``: [K, N]. Output in ``x``'s dtype — drop-in for the
+    model zoo's projection matmuls.
+    """
+    orig_dtype = x.dtype
+    qx, sx = quantize_e4m3(x.astype(jnp.float32))
+    qw, sw = quantize_e4m3(w.astype(jnp.float32))
+    contract = (((x.ndim - 1,), (0,)), ((), ()))
+    out = jax.lax.dot_general(qx, qw, contract, preferred_element_type=jnp.float32)
+    return (out * (sx * sw)).astype(orig_dtype)

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import optax
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -56,10 +58,12 @@ class LocalSGD:
         self.accelerator = accelerator
         self.model = model
         self.tx = optimizer_tx
-        self.local_sgd_steps = max(int(local_sgd_steps), 1)
+        # enabled=False = synchronized training in the same loop (reference
+        # local_sgd.py:45): syncing every step IS synchronous SGD (averaging
+        # replicas each step == averaging gradients for any linear update).
         self.enabled = enabled
-        state = AcceleratorState()
-        self.mesh = state.mesh
+        self.local_sgd_steps = max(int(local_sgd_steps), 1) if enabled else 1
+        self.mesh = accelerator.mesh if accelerator is not None else AcceleratorState().mesh
         self.num_workers = self.mesh.shape.get(MESH_AXIS_DATA, 1)
         self._counter = 0
         self._step_fns: dict = {}  # keyed by loss_fn object (cf. Accelerator._grad_fns)
@@ -82,14 +86,13 @@ class LocalSGD:
         )
 
     def __enter__(self) -> "LocalSGD":
-        if not self.enabled:
-            return self
+        self._counter = 0
         self._params_w = self._stack(self.model.params)
         self._opt_w = jax.vmap(self.tx.init)(self._params_w)
         return self
 
     def __exit__(self, *exc) -> None:
-        if not self.enabled or self._params_w is None:
+        if self._params_w is None:
             return
         self._sync()
         # write the averaged replica back onto the model's own shardings
@@ -106,8 +109,6 @@ class LocalSGD:
         def one_worker(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
-            import optax
-
             return optax.apply_updates(params, updates), opt_state, loss
 
         @jax.jit
@@ -122,9 +123,8 @@ class LocalSGD:
 
     def step(self, loss_fn: Callable, batch: Any) -> jax.Array:
         """One independent update per worker; mean loss returned. Syncs every
-        ``local_sgd_steps`` calls (reference LocalSGD.step, local_sgd.py:81)."""
-        if not self.enabled:
-            raise RuntimeError("LocalSGD(enabled=False): call your normal step instead.")
+        ``local_sgd_steps`` calls (reference LocalSGD.step, local_sgd.py:81);
+        with ``enabled=False`` every step syncs — plain synchronous SGD."""
         if self._params_w is None:
             raise RuntimeError("LocalSGD.step() outside the context manager.")
         if loss_fn not in self._step_fns:

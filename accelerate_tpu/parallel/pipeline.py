@@ -37,7 +37,7 @@ def _is_narrow_float(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits < 32
 
 
-def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
+def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None):
     """Build ``fn(stacked_layer_params, h, cos, sin, mask) -> h`` running the
     decoder stack as a pipeline over the ``pipeline`` mesh axis.
 
@@ -74,7 +74,7 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int):
 
         def stage(h_mb, mask_mb):
             def body(hh, lp):
-                hh, _ = decoder_layer(cfg, hh, lp, cos, sin, mask_mb, causal=True)
+                hh, _ = decoder_layer(cfg, hh, lp, cos, sin, mask_mb, causal=True, dot_fn=dot_fn)
                 return hh, None
 
             out, _ = jax.lax.scan(body, h_mb, layers)

@@ -365,7 +365,10 @@ class MixedPrecisionPolicy:
             PrecisionType.NO: jnp.float32,
             PrecisionType.FP16: jnp.float16,
             PrecisionType.BF16: jnp.bfloat16,
-            PrecisionType.FP8: jnp.bfloat16,  # fp8 applies per-matmul, not globally
+            # fp8: projections run as scaled-e4m3 dot_generals (ops/fp8.py,
+            # wired by prepare_model); everything else computes in bf16 —
+            # the TE fp8_autocast split (reference transformer_engine.py:24)
+            PrecisionType.FP8: jnp.bfloat16,
         }[self.mixed_precision]
 
     @property

@@ -176,3 +176,25 @@ def test_local_sgd_requires_context():
     lsgd = LocalSGD(acc, model, optax.sgd(0.1))
     with pytest.raises(RuntimeError, match="context"):
         lsgd.step(_loss, _data())
+
+
+def test_local_sgd_disabled_is_synchronous():
+    """enabled=False runs the same loop fully synchronized (reference parity)."""
+    _reset()
+    acc = Accelerator()
+    model = acc.prepare_model(LinearModel())
+    batch = _data()
+    with LocalSGD(acc, model, optax.sgd(0.1), local_sgd_steps=8, enabled=False) as lsgd:
+        assert lsgd.local_sgd_steps == 1
+        for _ in range(6):
+            lsgd.step(_loss, batch)
+    local = jax.device_get(model.params)
+
+    params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    for _ in range(6):
+        g = jax.grad(_loss)(params, batch)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(float(local["a"]), float(params["a"]), rtol=1e-5)
