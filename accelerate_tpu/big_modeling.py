@@ -183,19 +183,19 @@ class StreamedCausalLM(_LayerStreamer):
         return self._put(np.asarray(value))
 
     def _get_layer_fn(self):
-        if self._layer_fn is None:
+        # keyed on dot_fn: toggling fp8 on the model must recompile
+        dot_fn = getattr(self.model, "dot_fn", None)
+        if self._layer_fn is None or self._layer_fn[0] is not dot_fn:
             cfg = self.config
             unpack = self.packer.unpack
-
-            dot_fn = getattr(self.model, "dot_fn", None)
 
             @jax.jit
             def layer_fn(h, buf, cos, sin, mask):
                 h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True, dot_fn=dot_fn)
                 return h
 
-            self._layer_fn = layer_fn
-        return self._layer_fn
+            self._layer_fn = (dot_fn, layer_fn)
+        return self._layer_fn[1]
 
     def __call__(self, input_ids, attention_mask: Optional[Any] = None) -> jax.Array:
         """Full-sequence logits [B, S, V]."""
@@ -220,11 +220,10 @@ class StreamedCausalLM(_LayerStreamer):
         return (h @ head.astype(h.dtype)).astype(jnp.float32)
 
     def _get_cached_layer_fn(self):
-        if self._cached_layer_fn is None:
+        dot_fn = getattr(self.model, "dot_fn", None)
+        if self._cached_layer_fn is None or self._cached_layer_fn[0] is not dot_fn:
             cfg = self.config
             unpack = self.packer.unpack
-
-            dot_fn = getattr(self.model, "dot_fn", None)
 
             @jax.jit
             def fn(h, buf, cache, length, cos, sin, mask):
@@ -235,8 +234,8 @@ class StreamedCausalLM(_LayerStreamer):
                 )
                 return h, {"k": new_cache["k"], "v": new_cache["v"]}
 
-            self._cached_layer_fn = fn
-        return self._cached_layer_fn
+            self._cached_layer_fn = (dot_fn, fn)
+        return self._cached_layer_fn[1]
 
     def generate(self, input_ids, max_new_tokens: int = 20, temperature: float = 0.0, rng=None) -> np.ndarray:
         """Greedy/sampled decode; each token streams the offloaded layers once

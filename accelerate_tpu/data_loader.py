@@ -311,12 +311,21 @@ class DataLoaderStateMixin:
 
 class BaseDataLoader(DataLoaderStateMixin):
     """Common machinery: one-batch lookahead (to flag end-of-epoch *before* the
-    last batch is consumed — reference data_loader.py:450-471) and global-array
-    assembly."""
+    last batch is consumed — reference data_loader.py:450-471), global-array
+    assembly, and async prefetch.
 
-    def __init__(self, device_placement: bool = True, non_blocking: bool = False):
+    ``prefetch > 0`` runs collate + global-array assembly + H2D transfer in a
+    background thread, ``prefetch`` batches ahead of the training step — the
+    reference's MpDeviceLoader transfer threads (data_loader.py:504-545).
+    Batch order and end-of-epoch semantics are identical to the synchronous
+    path: the producer only *tags* the final batch; the epoch-state flags
+    flip on the consumer side right before that batch is yielded.
+    """
+
+    def __init__(self, device_placement: bool = True, non_blocking: bool = False, prefetch: int = 2):
         self.device_placement = device_placement
         self.non_blocking = non_blocking
+        self.prefetch = prefetch
         self.gradient_state = GradientState()
         self.state = PartialState()
         self.reset()
@@ -339,24 +348,88 @@ class BaseDataLoader(DataLoaderStateMixin):
 
         return recursively_apply(_make, local_batch)
 
+    def _mark_last_batch(self) -> None:
+        self.end_of_dataloader = True
+        if getattr(self, "_total_samples", None) is not None:
+            self.remainder = self._total_samples % self.total_batch_size or -1
+
     def _iterate_with_lookahead(self, batches: Iterator):
+        if self.prefetch and self.prefetch > 0:
+            yield from self._iterate_prefetched(batches)
+            return
         self.begin()
         try:
             current = None
             have_current = False
-            batch_index = 0
             for nxt in batches:
                 if have_current:
                     yield self._globalize(current)
-                    batch_index += 1
                 current = nxt
                 have_current = True
             if have_current:
-                self.end_of_dataloader = True
-                if getattr(self, "_total_samples", None) is not None:
-                    self.remainder = self._total_samples % self.total_batch_size or -1
+                self._mark_last_batch()
                 yield self._globalize(current)
         finally:
+            self.end()
+
+    def _iterate_prefetched(self, batches: Iterator):
+        """Producer thread collates/globalizes up to ``prefetch`` batches ahead
+        while the consumer's step runs — H2D rides DMA under the compute."""
+        import queue
+        import threading
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                current = None
+                have_current = False
+                for nxt in batches:
+                    if have_current and not _put(("batch", self._globalize(current), False)):
+                        return
+                    current = nxt
+                    have_current = True
+                if have_current:
+                    if not _put(("batch", self._globalize(current), True)):
+                        return
+            except BaseException as exc:  # surface dataset/collate errors in the consumer
+                _put(("error", exc, False))
+                return
+            _put(("done", None, False))
+
+        self.begin()
+        thread = threading.Thread(target=produce, name="accelerate-tpu-prefetch", daemon=True)
+        thread.start()
+        try:
+            while True:
+                kind, payload, is_last = q.get()
+                if kind == "done":
+                    break
+                if kind == "error":
+                    raise payload
+                if is_last:
+                    self._mark_last_batch()
+                yield payload
+                if is_last:
+                    break
+        finally:
+            stop.set()
+            while True:  # unblock a producer stuck on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=5)
             self.end()
 
 
@@ -373,9 +446,10 @@ class DataLoaderShard(BaseDataLoader):
         collate_fn: Optional[Callable] = None,
         device_placement: bool = True,
         split_batches: bool = False,
+        prefetch: int = 2,
         **kwargs,
     ):
-        super().__init__(device_placement=device_placement)
+        super().__init__(device_placement=device_placement, prefetch=prefetch)
         self.dataset = dataset
         self.batch_sampler = batch_sampler
         self.collate_fn = collate_fn or default_collate
@@ -428,8 +502,9 @@ class IterableDataLoaderShard(BaseDataLoader):
         dataset_shard: IterableDatasetShard,
         collate_fn: Optional[Callable] = None,
         device_placement: bool = True,
+        prefetch: int = 2,
     ):
-        super().__init__(device_placement=device_placement)
+        super().__init__(device_placement=device_placement, prefetch=prefetch)
         self.dataset = dataset_shard
         self.collate_fn = collate_fn or default_collate
         self._total_samples = None
@@ -474,7 +549,10 @@ class DataLoaderDispatcher(BaseDataLoader):
         device_placement: bool = True,
         drop_last: bool = False,
     ):
-        super().__init__(device_placement=device_placement)
+        # prefetch=0: the scatter path issues cross-process broadcasts, which
+        # must stay on the main thread in the same order as the training
+        # step's collectives — a producer thread could reorder them per host
+        super().__init__(device_placement=device_placement, prefetch=0)
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate
@@ -562,6 +640,7 @@ def prepare_data_loader(
     even_batches: bool = True,
     dispatch_batches: Optional[bool] = None,
     use_seedable_sampler: bool = True,
+    prefetch: Optional[int] = None,
 ) -> BaseDataLoader:
     """Decide the sharding strategy and build the loader (data_loader.py:745-978).
 
@@ -601,6 +680,12 @@ def prepare_data_loader(
     indexable = hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__")
 
     if dispatch_batches:
+        if prefetch:
+            logger.warning(
+                "prefetch is not supported with dispatch_batches=True (the "
+                "scatter path's cross-process broadcasts must stay on the main "
+                "thread, in order) — continuing without prefetching."
+            )
         return DataLoaderDispatcher(
             dataset,
             batch_size=batch_size if not split_batches else batch_size // state.num_processes,
@@ -608,6 +693,7 @@ def prepare_data_loader(
             device_placement=device_placement,
             drop_last=drop_last,
         )
+    prefetch = 2 if prefetch is None else prefetch
 
     if not indexable:
         shard = IterableDatasetShard(
@@ -618,7 +704,9 @@ def prepare_data_loader(
             drop_last=drop_last,
             split_batches=split_batches,
         )
-        return IterableDataLoaderShard(shard, collate_fn=collate_fn, device_placement=device_placement)
+        return IterableDataLoaderShard(
+            shard, collate_fn=collate_fn, device_placement=device_placement, prefetch=prefetch
+        )
 
     n = len(dataset)
     # Shuffling is always (seed, epoch)-derived: jax has no mutable global
@@ -640,6 +728,7 @@ def prepare_data_loader(
         collate_fn=collate_fn,
         device_placement=device_placement,
         split_batches=split_batches,
+        prefetch=prefetch,
     )
 
 

@@ -13,6 +13,13 @@ import jax
 import jax.numpy as jnp
 
 
+def resolve_dot(dot_fn):
+    """The projection-matmul hook with its default: plain ``@`` when no
+    override (e.g. ops.fp8.fp8_dot) is installed. One definition, used by
+    every layer body."""
+    return dot_fn if dot_fn is not None else (lambda a, w: a @ w)
+
+
 def dense_init(key: jax.Array, shape: tuple, fan_in: int) -> jax.Array:
     """Scaled-normal initializer shared by the model zoo."""
     return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(jnp.float32)

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.constants import MESH_AXIS_TENSOR
-from .attention import dense_init, dot_product_attention, dropout
+from .attention import dense_init, dot_product_attention, dropout, resolve_dot
 from .config import TransformerConfig, get_config
 from .llama import BATCH_AXES, _constrain
 
@@ -135,7 +135,7 @@ class Bert:
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
 
-        dot = self.dot_fn if self.dot_fn is not None else (lambda a, w: a @ w)
+        dot = resolve_dot(self.dot_fn)
 
         def layer(h, xs):
             lp = xs[0] if use_dropout else xs
@@ -190,7 +190,7 @@ class Bert:
         """One encoder layer; identical math to the scan body in ``apply``
         (including the dot_fn hook, so fp8 dispatch matches fp8 training)."""
         cfg = self.config
-        dot = self.dot_fn if self.dot_fn is not None else (lambda a, w: a @ w)
+        dot = resolve_dot(self.dot_fn)
         h, mask = carry
         b, s, _ = h.shape
         nh = cfg.num_heads

@@ -63,9 +63,9 @@ def decoder_layer(
     """The one llama decoder layer used by every execution path (training
     scan, KV-cache decode, streamed big-model inference). Returns
     (h, updated_cache_or_None)."""
-    from .attention import dropout  # local import to avoid cycle at module load
+    from .attention import dropout, resolve_dot  # local import to avoid cycle at module load
 
-    dot = dot_fn if dot_fn is not None else (lambda a, b: a @ b)
+    dot = resolve_dot(dot_fn)
     b, s = h.shape[:2]
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
     x = rms_norm(h, lp["attn_norm"], cfg.norm_eps)

@@ -132,14 +132,21 @@ class MoEBlock:
 
 
 def _constrain_expert(value: jax.Array) -> jax.Array:
-    """Pin the leading expert dim to the expert mesh axis when inside jit.
+    """Pin the leading expert dim to the expert mesh axis.
 
-    Mesh presence is checked explicitly (not try/except) so that a genuine
-    sharding error — e.g. num_experts not divisible by the expert axis —
-    surfaces instead of silently dropping the constraint."""
-    from jax.sharding import PartitionSpec as P
+    The constraint is built against the *concrete* Accelerator mesh (a bare
+    PartitionSpec needs an ambient mesh context, which plain ``jax.jit`` with
+    NamedSharding-typed arguments never establishes). Skipped only when no
+    topology singleton exists (plain eager use); a genuine sharding error —
+    e.g. num_experts not divisible by the expert axis — then surfaces."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh.empty or MESH_AXIS_EXPERT not in mesh.axis_names:
-        return value  # plain eager use outside any mesh
-    return jax.lax.with_sharding_constraint(value, P(MESH_AXIS_EXPERT, *([None] * (value.ndim - 1))))
+    from ..state import PartialState
+
+    if not PartialState._shared_state:  # no Accelerator/mesh in this process
+        return value
+    mesh = PartialState().mesh
+    if mesh.shape.get(MESH_AXIS_EXPERT, 1) <= 1:
+        return value
+    sharding = NamedSharding(mesh, P(MESH_AXIS_EXPERT, *([None] * (value.ndim - 1))))
+    return jax.lax.with_sharding_constraint(value, sharding)

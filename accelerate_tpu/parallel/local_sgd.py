@@ -58,11 +58,13 @@ class LocalSGD:
         self.accelerator = accelerator
         self.model = model
         self.tx = optimizer_tx
-        # enabled=False = synchronized training in the same loop (reference
-        # local_sgd.py:45): syncing every step IS synchronous SGD (averaging
-        # replicas each step == averaging gradients for any linear update).
+        # enabled=False = true synchronized training in the same loop
+        # (reference local_sgd.py:45): no replicas at all — one update on the
+        # full batch. (Syncing replicas every step is only equivalent for
+        # linear optimizers like SGD; Adam moments built on 1/W shards would
+        # diverge, so the disabled path avoids the worker axis entirely.)
         self.enabled = enabled
-        self.local_sgd_steps = max(int(local_sgd_steps), 1) if enabled else 1
+        self.local_sgd_steps = max(int(local_sgd_steps), 1)
         self.mesh = accelerator.mesh if accelerator is not None else AcceleratorState().mesh
         self.num_workers = self.mesh.shape.get(MESH_AXIS_DATA, 1)
         self._counter = 0
@@ -87,6 +89,10 @@ class LocalSGD:
 
     def __enter__(self) -> "LocalSGD":
         self._counter = 0
+        if not self.enabled:
+            self._params_w = self.model.params
+            self._opt_w = self.tx.init(self.model.params)
+            return self
         self._params_w = self._stack(self.model.params)
         self._opt_w = jax.vmap(self.tx.init)(self._params_w)
         return self
@@ -94,10 +100,13 @@ class LocalSGD:
     def __exit__(self, *exc) -> None:
         if self._params_w is None:
             return
-        self._sync()
-        # write the averaged replica back onto the model's own shardings
-        averaged = jax.tree.map(lambda x: x[0], self._params_w)
-        self.model.params = jax.device_put(averaged, self.model.params_shardings)
+        if self.enabled:
+            self._sync()
+            # write the averaged replica back onto the model's own shardings
+            averaged = jax.tree.map(lambda x: x[0], self._params_w)
+            self.model.params = jax.device_put(averaged, self.model.params_shardings)
+        else:
+            self.model.params = jax.device_put(self._params_w, self.model.params_shardings)
         self._params_w = self._opt_w = None
 
     # -- the local step ------------------------------------------------------
@@ -110,6 +119,9 @@ class LocalSGD:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
+
+        if not self.enabled:  # plain synchronous update, no worker axis
+            return jax.jit(one_worker)
 
         @jax.jit
         def step(params_w, opt_w, batch):
@@ -131,7 +143,7 @@ class LocalSGD:
             self._step_fns[loss_fn] = self._build_step(loss_fn)
         self._params_w, self._opt_w, losses = self._step_fns[loss_fn](self._params_w, self._opt_w, batch)
         self._counter += 1
-        if self._counter % self.local_sgd_steps == 0:
+        if self.enabled and self._counter % self.local_sgd_steps == 0:
             self._sync()
         return losses.mean()
 

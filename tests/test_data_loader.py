@@ -163,3 +163,102 @@ def test_total_batch_size():
     loader = prepare_data_loader(DictDataset(32), batch_size=4)
     # single process: total == per-process
     assert loader.total_batch_size == 4
+
+
+# -- async prefetch ----------------------------------------------------------
+
+
+class _SlowDataset:
+    """Collate cost simulated in __getitem__ (runs in the producer thread)."""
+
+    def __init__(self, n=24, delay=0.01):
+        import numpy as _np
+
+        self.x = _np.arange(n, dtype=_np.float32)
+        self.delay = delay
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        import time as _t
+
+        _t.sleep(self.delay)
+        return {"x": self.x[i]}
+
+
+def test_prefetch_yields_identical_batches():
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    ds = _SlowDataset(n=24, delay=0.0)
+    sync_batches = [np.asarray(b["x"]) for b in prepare_data_loader(ds, batch_size=4, prefetch=0)]
+    async_batches = [np.asarray(b["x"]) for b in prepare_data_loader(ds, batch_size=4, prefetch=2)]
+    assert len(sync_batches) == len(async_batches)
+    for s, a in zip(sync_batches, async_batches):
+        np.testing.assert_array_equal(s, a)
+
+
+def test_prefetch_overlaps_step_time():
+    """With prefetch, data production hides under a slow consumer step."""
+    import time
+
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    per_item, batch, n = 0.004, 4, 32
+    step_time = per_item * batch  # consumer exactly as slow as the producer
+
+    def run(prefetch):
+        loader = prepare_data_loader(_SlowDataset(n=n, delay=per_item), batch_size=batch, prefetch=prefetch)
+        start = time.perf_counter()
+        for _ in loader:
+            time.sleep(step_time)
+        return time.perf_counter() - start
+
+    t_async = run(2)
+    t_sync = run(0)
+    # perfect overlap halves the wall time; demand at least 25% to stay
+    # robust against CI scheduling noise
+    assert t_async < t_sync * 0.75, f"no overlap: async {t_async:.3f}s vs sync {t_sync:.3f}s"
+
+
+def test_prefetch_propagates_dataset_errors():
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    class Broken:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i >= 4:
+                raise RuntimeError("boom at item 4")
+            return {"x": np.float32(i)}
+
+    loader = prepare_data_loader(Broken(), batch_size=4, prefetch=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_prefetch_abandoned_iteration_cleans_up():
+    import threading
+
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    before = threading.active_count()
+    loader = prepare_data_loader(_SlowDataset(n=32, delay=0.001), batch_size=4, prefetch=2)
+    it = iter(loader)
+    next(it)
+    it.close()  # abandon mid-epoch
+    # producer thread must wind down (it is joined in the generator finally)
+    assert threading.active_count() <= before + 1
+
+
+def test_prefetch_end_of_dataloader_flag_timing():
+    """The flag must flip only when the LAST batch is handed out, even though
+    the producer finished reading the dataset batches earlier."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    loader = prepare_data_loader(_SlowDataset(n=12, delay=0.0), batch_size=4, prefetch=3)
+    seen = []
+    for batch in loader:
+        seen.append(loader.end_of_dataloader)
+    assert seen == [False, False, True]

@@ -179,22 +179,24 @@ def test_local_sgd_requires_context():
 
 
 def test_local_sgd_disabled_is_synchronous():
-    """enabled=False runs the same loop fully synchronized (reference parity)."""
+    """enabled=False runs the same loop fully synchronized (reference parity)
+    — exactly, for ANY optimizer (Adam moments included), since the disabled
+    path skips the worker axis entirely."""
     _reset()
     acc = Accelerator()
     model = acc.prepare_model(LinearModel())
     batch = _data()
-    with LocalSGD(acc, model, optax.sgd(0.1), local_sgd_steps=8, enabled=False) as lsgd:
-        assert lsgd.local_sgd_steps == 1
+    with LocalSGD(acc, model, optax.adam(0.1), local_sgd_steps=8, enabled=False) as lsgd:
         for _ in range(6):
             lsgd.step(_loss, batch)
     local = jax.device_get(model.params)
 
     params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
-    tx = optax.sgd(0.1)
+    tx = optax.adam(0.1)
     opt_state = tx.init(params)
     for _ in range(6):
         g = jax.grad(_loss)(params, batch)
         updates, opt_state = tx.update(g, opt_state, params)
         params = optax.apply_updates(params, updates)
     np.testing.assert_allclose(float(local["a"]), float(params["a"]), rtol=1e-5)
+    np.testing.assert_allclose(float(local["b"]), float(params["b"]), rtol=1e-5)
