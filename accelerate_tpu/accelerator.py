@@ -668,6 +668,30 @@ class Accelerator:
         applied functionally inside jitted functions, not via a context."""
         yield
 
+    @contextmanager
+    def profile(self, log_dir: Optional[str] = None):
+        """Capture a ``jax.profiler`` device trace for the enclosed steps
+        (SURVEY §5.1: the reference has only Megatron timers; XLA gives full
+        timeline traces). View with TensorBoard or Perfetto::
+
+            with accelerator.profile("/tmp/trace"):
+                for batch in loader:
+                    step(batch)
+        """
+        if log_dir is None:
+            log_dir = os.path.join(self.project_configuration.logging_dir or ".", "profile")
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield log_dir
+        finally:
+            # drain async dispatch on EVERY device so the trace covers the
+            # final step's work on the whole mesh, not just device 0
+            for device in jax.local_devices():
+                # the +1 is a compute op: it queues behind in-flight programs
+                # on that device's stream (a bare transfer rides DMA instead)
+                (jax.device_put(0.0, device) + 1).block_until_ready()
+            jax.profiler.stop_trace()
+
     # ------------------------------------------------------------------
     # fused fast path
     # ------------------------------------------------------------------

@@ -798,5 +798,6 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             collate_fn=dataloader.collate_fn,
             device_placement=dataloader.device_placement,
             split_batches=dataloader.split_batches,
+            prefetch=dataloader.prefetch,
         )
     return SkipDataLoader(dataloader, num_batches)

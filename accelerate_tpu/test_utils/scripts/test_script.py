@@ -1,46 +1,244 @@
-"""Distributed sanity payload run by `accelerate-tpu test`.
+"""Distributed correctness payload run by `accelerate-tpu test`.
 
-Parity: reference test_utils/scripts/test_script.py (the 802-LoC correctness
-suite) — this covers the topology/ops/RNG slice; training parity lives in the
-pytest suite (tests/test_accelerator.py).
+Parity: reference test_utils/scripts/test_script.py (the 802-LoC suite run by
+`accelerate test`): RNG sync, dataloader shard exactness vs a baseline
+loader, training parity vs a plain single-program loop, gradient-accumulation
+semantics, gather_for_metrics remainder dedup, and process-control execution
+checks. Runs on any topology — one chip, a pod slice, or the virtual CPU
+mesh — with the same assertions.
 """
+
+from __future__ import annotations
+
+import os
+import tempfile
 
 import numpy as np
 
 
-def main():
-    from accelerate_tpu import PartialState, set_seed
+class _LinearModel:
+    """y = a*x + b with the (init, apply) protocol prepare() expects."""
+
+    def init(self, rng):
+        import jax.numpy as jnp
+
+        del rng
+        return {"a": jnp.zeros(()), "b": jnp.zeros(())}
+
+    @staticmethod
+    def apply(params, x):
+        return params["a"] * x + params["b"]
+
+
+def _linear_loss(params, batch):
+    import jax.numpy as jnp
+
+    return jnp.mean((_LinearModel.apply(params, batch["x"]) - batch["y"]) ** 2)
+
+
+def check_topology_and_ops(state):
     from accelerate_tpu import ops
-    from accelerate_tpu.utils import next_rng_key
 
-    state = PartialState()
     state.print(f"Topology: {state!r}")
-
-    # ops roundtrip
     batch = {"x": np.arange(8 * state.num_devices, dtype=np.float32).reshape(-1, 1)}
     device_batch = ops.send_to_device(batch)
     gathered = ops.gather(device_batch)
     assert np.array_equal(gathered["x"], batch["x"]), "gather roundtrip failed"
 
-    # reduction
     total = ops.reduce({"v": np.ones(3)}, "sum")
-    assert np.allclose(total["v"], state.num_processes * np.ones(3))
+    assert np.allclose(total["v"], state.num_processes * np.ones(3)), "reduce sum failed"
 
-    # seeded RNG determinism
+
+def check_rng_determinism():
+    import jax
+
+    from accelerate_tpu import set_seed
+    from accelerate_tpu.utils import next_rng_key
+
     set_seed(123)
     k1 = next_rng_key()
     set_seed(123)
     k2 = next_rng_key()
+    assert (jax.random.key_data(k1) == jax.random.key_data(k2)).all(), "seeded RNG not deterministic"
+
+
+def check_dataloader_shard_exactness(state):
+    """Union of every rank's batches covers the dataset, every rank yields the
+    same batch count (reference test_script.py BatchSamplerShard checks)."""
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard, SequentialSampler
+
+    n, bs = 37, 4
+    for even_batches in (True, False):
+        shards = [
+            list(
+                BatchSamplerShard(
+                    BatchSampler(SequentialSampler(n), batch_size=bs, drop_last=False),
+                    num_processes=state.num_processes,
+                    process_index=p,
+                    even_batches=even_batches,
+                )
+            )
+            for p in range(state.num_processes)
+        ]
+        assert len({len(s) for s in shards}) == 1, "uneven shard batch counts (desync/hang risk)"
+        seen = {i for shard in shards for batch in shard for i in batch}
+        missing = set(range(n)) - seen
+        if even_batches:
+            assert not missing, f"shards dropped samples: {missing}"
+
+
+def check_training_parity(accelerator):
+    """Distributed loop == plain jax loop, to float tolerance
+    (reference test_script.py training_check)."""
+    import optax
+
     import jax
+    import jax.numpy as jnp
 
-    assert (jax.random.key_data(k1) == jax.random.key_data(k2)).all()
+    from accelerate_tpu.test_utils.training import RegressionDataset
 
-    # process-control
+    ds = RegressionDataset(length=64, seed=7)
+
+    class Wrapped:
+        def __len__(self):
+            return len(ds.x)
+
+        def __getitem__(self, i):
+            return {"x": ds.x[i], "y": ds.y[i]}
+
+    prepared, opt, loader = accelerator.prepare(_LinearModel(), optax.sgd(0.1), Wrapped())
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            accelerator.backward(_linear_loss, batch)
+            opt.step()
+            opt.zero_grad()
+    dist = jax.device_get(prepared.params)
+
+    params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    bs = loader.total_batch_size
+    for _ in range(2):
+        for start in range(0, 64, bs):
+            b = {"x": jnp.asarray(ds.x[start : start + bs]), "y": jnp.asarray(ds.y[start : start + bs])}
+            g = jax.grad(_linear_loss)(params, b)
+            updates, opt_state = tx.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+    for key in dist:
+        np.testing.assert_allclose(
+            np.asarray(dist[key]), np.asarray(params[key]), rtol=1e-4, atol=1e-5,
+            err_msg=f"training parity diverged on {key}",
+        )
+
+
+def check_gradient_accumulation(accelerator_factory):
+    """accum=N over N microbatches == one step on the concatenated batch
+    (reference test_sync.py)."""
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32,)).astype(np.float32)
+    y = (2 * x + 1).astype(np.float32)
+
+    acc = accelerator_factory(4)
+    model, opt = acc.prepare(_LinearModel(), optax.sgd(0.1))
+    for i in range(4):
+        with acc.accumulate(model):
+            acc.backward(
+                _linear_loss,
+                {"x": jnp.asarray(x[i * 8 : (i + 1) * 8]), "y": jnp.asarray(y[i * 8 : (i + 1) * 8])},
+            )
+            opt.step()
+            opt.zero_grad()
+    accumulated = jax.device_get(model.params)
+
+    acc2 = accelerator_factory(1)
+    model2, opt2 = acc2.prepare(_LinearModel(), optax.sgd(0.1))
+    acc2.backward(_linear_loss, {"x": jnp.asarray(x), "y": jnp.asarray(y)})
+    opt2.step()
+    full = jax.device_get(model2.params)
+    np.testing.assert_allclose(float(accumulated["a"]), float(full["a"]), rtol=1e-5)
+    np.testing.assert_allclose(float(accumulated["b"]), float(full["b"]), rtol=1e-5)
+
+
+def check_gather_for_metrics(accelerator):
+    """Padded duplicate samples on the final batch are dropped
+    (reference external_deps/test_metrics.py)."""
+    n = accelerator.num_processes * 8 + 3  # uneven tail
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    loader = accelerator.prepare_data_loader(DS(), batch_size=8)
+    seen = []
+    for batch in loader:
+        seen.append(np.asarray(accelerator.gather_for_metrics(batch["x"])))
+    flat = np.concatenate(seen)
+    assert len(flat) == n, f"gather_for_metrics kept {len(flat)} of {n} samples"
+    assert set(flat.astype(int).tolist()) == set(range(n))
+
+
+def check_process_execution(state):
+    """main_process_first ordering + on_main_process decorators + splitting
+    (reference test_script.py:85-116 process_execution_check)."""
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "marker.txt")
+        with state.main_process_first():
+            if state.is_main_process:
+                with open(marker, "w") as f:
+                    f.write("main was here")
+        if state.is_main_process:
+            assert os.path.exists(marker)
+
+    calls = []
+
+    @state.on_main_process
+    def only_main():
+        calls.append("main")
+
+    only_main()
+    assert (len(calls) == 1) == (state.is_main_process or state.num_processes == 1)
+
     with state.split_between_processes(list(range(state.num_processes * 2))) as piece:
         assert len(piece) == 2
 
-    state.wait_for_everyone()
-    state.print("All sanity checks passed.")
+
+def main():
+    from accelerate_tpu import Accelerator, GradientAccumulationPlugin, PartialState
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    state = PartialState()
+    check_topology_and_ops(state)
+    check_rng_determinism()
+    check_dataloader_shard_exactness(state)
+    check_process_execution(state)
+
+    def fresh_accelerator(accum_steps: int = 1) -> Accelerator:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        from accelerate_tpu import set_seed
+
+        set_seed(0)
+        return Accelerator(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(
+                num_steps=accum_steps, sync_with_dataloader=False
+            )
+        )
+
+    check_training_parity(fresh_accelerator())
+    check_gradient_accumulation(fresh_accelerator)
+    check_gather_for_metrics(fresh_accelerator())
+
+    PartialState().print("All distributed correctness checks passed.")
 
 
 if __name__ == "__main__":

@@ -56,6 +56,22 @@ class GeneralTracker:
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         raise NotImplementedError
 
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """{name: HWC/NHWC array} (reference WandBTracker.log_images, :339).
+        Optional — trackers without image support log a warning once."""
+        logger.warning_once(f"Tracker {self.name} does not support log_images; skipping.")
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        step: Optional[int] = None,
+        **kwargs,
+    ) -> None:
+        """Tabular logging (reference WandBTracker.log_table, :360)."""
+        logger.warning_once(f"Tracker {self.name} does not support log_table; skipping.")
+
     def finish(self) -> None:
         pass
 
@@ -121,6 +137,18 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        import wandb
+
+        self.run.log({k: [wandb.Image(img) for img in v] if isinstance(v, (list, tuple)) else wandb.Image(v) for k, v in values.items()}, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name: str, columns=None, data=None, step: Optional[int] = None, **kwargs) -> None:
+        import wandb
+
+        self.run.log({table_name: wandb.Table(columns=columns, data=data)}, step=step, **kwargs)
 
     @on_main_process
     def finish(self) -> None:
@@ -193,10 +221,84 @@ class JSONLTracker(GeneralTracker):
         self._file.close()
 
 
+@register_tracker
+class CometMLTracker(GeneralTracker):
+    """Comet (reference tracking.py:399-477)."""
+
+    name = "comet_ml"
+
+    def __init__(self, run_name: str, **kwargs):
+        from comet_ml import Experiment
+
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.writer.set_step(step)
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.log_metric(k, v, step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.log_other(k, v, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.log_metrics(v, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in values.items():
+            self.writer.log_image(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.end()
+
+
+@register_tracker
+class AimTracker(GeneralTracker):
+    """Aim (reference tracking.py:480-576)."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        import aim
+
+        for k, v in values.items():
+            self.writer.track(aim.Image(v, **kwargs.get("aim_image_kw", {})), name=k, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
 _AVAILABILITY = {
     "tensorboard": is_tensorboard_available,
     "wandb": is_wandb_available,
     "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
     "jsonl": lambda: True,
 }
 

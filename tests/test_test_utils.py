@@ -1,0 +1,39 @@
+"""The self-launched distributed payload must pass on the virtual mesh
+(reference tests/test_multigpu.py: launcher + test_script subprocess), and the
+profiler context must produce a trace."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_distributed_payload_passes_on_virtual_mesh():
+    from accelerate_tpu import test_utils
+
+    script = os.path.join(os.path.dirname(test_utils.__file__), "scripts", "test_script.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True, timeout=420, env=env
+    )
+    assert result.returncode == 0, f"payload failed:\n{result.stdout}\n{result.stderr}"
+    assert "All distributed correctness checks passed." in result.stdout
+
+
+def test_profile_context_writes_trace(tmp_path):
+    import jax
+
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator()
+    with acc.profile(str(tmp_path / "trace")) as log_dir:
+        (jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8))).block_until_ready()
+    found = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(log_dir)
+        for f in files
+    ]
+    assert found, "profiler produced no trace files"

@@ -1,0 +1,114 @@
+"""Tracker tests (reference tests/test_tracking.py, 531 LoC): real
+TensorBoard event files, JSONL round trip, resolution logic, Accelerator.log
+fan-out via a mock tracker."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import (
+    GeneralTracker,
+    JSONLTracker,
+    TensorBoardTracker,
+    filter_trackers,
+)
+from accelerate_tpu.utils import ProjectConfiguration
+
+
+class MockTracker(GeneralTracker):
+    name = "mock"
+    requires_logging_directory = False
+
+    def __init__(self):
+        self.config = None
+        self.logged = []
+        self.finished = False
+
+    def store_init_configuration(self, values):
+        self.config = values
+
+    def log(self, values, step=None, **kwargs):
+        self.logged.append((values, step))
+
+    def finish(self):
+        self.finished = True
+
+
+def test_jsonl_tracker_roundtrip(tmp_path):
+    tracker = JSONLTracker("run1", logging_dir=str(tmp_path))
+    tracker.store_init_configuration({"lr": 0.1})
+    tracker.log({"loss": 1.5}, step=0)
+    tracker.log({"loss": 0.5, "acc": 0.9}, step=1)
+    tracker.finish()
+    lines = [json.loads(l) for l in open(tmp_path / "run1" / "metrics.jsonl")]
+    assert lines[0]["_config"] == {"lr": 0.1}
+    assert lines[1] == {"loss": 1.5, "_step": 0, "_time": lines[1]["_time"]}
+    assert lines[2]["acc"] == 0.9
+
+
+def test_tensorboard_tracker_writes_event_files(tmp_path):
+    tracker = TensorBoardTracker("run1", logging_dir=str(tmp_path))
+    tracker.store_init_configuration({"lr": 0.1, "epochs": 2})
+    tracker.log({"loss": 1.0, "note": "hello", "grouped": {"a": 1.0, "b": 2.0}}, step=0)
+    tracker.finish()
+    assert glob.glob(str(tmp_path / "run1" / "events.out.tfevents.*"))
+    hparams = json.load(open(tmp_path / "run1" / "hparams.json"))
+    assert hparams == {"lr": 0.1, "epochs": 2}
+
+
+def test_filter_trackers_resolution(tmp_path):
+    # "all" resolves to every available tracker (jsonl always available)
+    trackers = filter_trackers("all", str(tmp_path), "proj", config={"x": 1})
+    names = {t.name for t in trackers}
+    assert "jsonl" in names and "tensorboard" in names
+    assert "comet_ml" not in names and "aim" not in names  # not installed → skipped
+    # config was stored on every resolved tracker
+    assert json.loads(open(tmp_path / "proj" / "metrics.jsonl").readline())["_config"] == {"x": 1}
+
+
+def test_filter_trackers_unknown_raises():
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("not-a-tracker", None, "proj")
+
+
+def test_filter_trackers_instance_passthrough():
+    mock = MockTracker()
+    trackers = filter_trackers([mock], None, "proj", config={"seed": 1})
+    assert trackers == [mock]
+    assert mock.config == {"seed": 1}
+
+
+def test_filter_trackers_requested_but_missing_skips(caplog):
+    # comet_ml is not installed in this image: requested explicitly → warn+skip
+    trackers = filter_trackers(["comet_ml", "jsonl"], "/tmp", "proj")
+    assert [t.name for t in trackers] == ["jsonl"]
+
+
+def test_accelerator_log_fans_out(tmp_path):
+    mock = MockTracker()
+    acc = Accelerator(
+        log_with=[mock],
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), logging_dir=str(tmp_path)),
+    )
+    acc.init_trackers("proj", {"lr": 3e-4})
+    assert mock.config == {"lr": 3e-4}
+    acc.log({"loss": 0.1}, step=5)
+    acc.log({"loss": 0.05}, step=6)
+    assert mock.logged == [({"loss": 0.1}, 5), ({"loss": 0.05}, 6)]
+    acc.end_training()
+    assert mock.finished
+
+
+def test_log_images_fallback_warns_not_crashes():
+    mock = MockTracker()
+    mock.log_images({"img": None})  # base-class fallback: warn once, no-op
+
+
+def test_trackers_registered():
+    from accelerate_tpu.tracking import _available_trackers
+
+    for name in ("tensorboard", "wandb", "mlflow", "comet_ml", "aim", "jsonl"):
+        assert name in _available_trackers
