@@ -18,6 +18,7 @@ from .big_modeling import (
     load_checkpoint_and_dispatch,
 )
 from .data_loader import prepare_data_loader, skip_first_batches
+from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .parallel.local_sgd import LocalSGD

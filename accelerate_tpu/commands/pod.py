@@ -1,0 +1,95 @@
+"""`accelerate-tpu pod-launch` — run a training script on every worker of a
+TPU pod slice.
+
+Parity: reference tpu_pod_launcher (commands/launch.py:812-868), rebuilt for
+the JAX process model: one process per host, `jax.distributed.initialize()`
+self-discovers the coordinator from the TPU metadata, so "pod launch" is
+simply *the same `accelerate-tpu launch` command executed on every worker* —
+no xla_dist server, no rendezvous flags. The fan-out transport is
+`gcloud compute tpus tpu-vm ssh --worker=all` (what `tpu-config` also uses,
+reference commands/tpu.py:90-157).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "pod-launch", help="Launch a training script on every worker of a TPU pod"
+    )
+    parser.add_argument("--tpu_name", required=True, help="Name of the TPU VM / pod slice")
+    parser.add_argument("--tpu_zone", required=True, help="GCE zone of the pod")
+    parser.add_argument("--use_alpha", action="store_true", help="Use `gcloud alpha`")
+    parser.add_argument("--use_sudo", action="store_true", help="Run the remote command under sudo")
+    parser.add_argument("--worker", default="all", help="Worker selector (default: all)")
+    parser.add_argument(
+        "--env",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="Environment variables exported on every worker (repeatable)",
+    )
+    parser.add_argument("--workdir", default=None, help="Remote directory to cd into first")
+    parser.add_argument(
+        "--debug", action="store_true", help="Print the gcloud command instead of running it"
+    )
+    parser.add_argument("--mixed_precision", default=None)
+    parser.add_argument("--num_processes", type=int, default=None, help="Total host count (optional; auto-detected on pods)")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs="...", default=[])
+    parser.set_defaults(func=run)
+    return parser
+
+
+def assemble_worker_command(args) -> str:
+    """The shell command each pod worker runs: env exports + the ordinary
+    per-host launch. Every worker runs the SAME command — process identity
+    comes from the TPU runtime, not from per-worker flags."""
+    parts: list[str] = []
+    if args.workdir:
+        parts.append(f"cd {shlex.quote(args.workdir)}")
+    exports = list(args.env)
+    exports.append("ACCELERATE_IN_TPU_POD=1")
+    for item in exports:
+        if "=" not in item:
+            raise ValueError(f"--env expects KEY=VALUE, got {item!r}")
+        key, _, value = item.partition("=")
+        parts.append(f"export {key}={shlex.quote(value)}")
+
+    launch = []
+    if args.use_sudo:
+        launch.append("sudo")
+    launch += ["accelerate-tpu", "launch"]
+    if args.mixed_precision:
+        launch += ["--mixed_precision", args.mixed_precision]
+    if args.num_processes is not None:
+        launch += ["--num_processes", str(args.num_processes)]
+    launch.append(args.training_script)
+    launch += list(args.training_script_args)
+    parts.append(" ".join(shlex.quote(p) for p in launch))
+    return "; ".join(parts)
+
+
+def build_gcloud_ssh_cmd(tpu_name: str, tpu_zone: str, command: str, worker: str = "all", use_alpha: bool = False) -> list[str]:
+    cmd = ["gcloud"]
+    if use_alpha:
+        cmd.append("alpha")
+    cmd += [
+        "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        "--zone", tpu_zone,
+        "--command", command,
+        "--worker", worker,
+    ]
+    return cmd
+
+
+def run(args) -> int:
+    command = assemble_worker_command(args)
+    cmd = build_gcloud_ssh_cmd(args.tpu_name, args.tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha)
+    if args.debug:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    return subprocess.run(cmd).returncode

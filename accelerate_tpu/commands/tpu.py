@@ -1,0 +1,82 @@
+"""`accelerate-tpu tpu-config` — run setup/maintenance commands on every
+worker of a TPU pod.
+
+Parity: reference commands/tpu.py:90-157 (gcloud ssh command runner with
+config-file defaults, command files, and an install helper).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+
+from .config import load_config_from_file
+from .pod import build_gcloud_ssh_cmd
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "tpu-config", help="Run commands on all workers of a TPU pod (setup, installs, ...)"
+    )
+    parser.add_argument("--config_file", default=None, help="YAML config with tpu_name/tpu_zone/commands")
+    parser.add_argument("--command", action="append", default=None, help="A command to run (repeatable)")
+    parser.add_argument("--command_file", default=None, help="File with one command per line")
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--worker", default="all")
+    parser.add_argument("--use_alpha", action="store_true")
+    parser.add_argument(
+        "--install_accelerate", action="store_true",
+        help="Prepend a pip install of this package on every worker",
+    )
+    parser.add_argument(
+        "--accelerate_version", default="latest",
+        help='Version to install with --install_accelerate ("latest" or an exact version)',
+    )
+    parser.add_argument("--debug", action="store_true", help="Print the gcloud command instead of running it")
+    parser.set_defaults(func=run)
+    return parser
+
+
+def assemble_pod_setup_command(args, config: dict | None = None) -> str:
+    """Resolve command sources (CLI > command file > YAML config) into the one
+    shell line every worker executes (reference tpu.py:111-127)."""
+    if config is None:
+        config = load_config_from_file(args.config_file)
+    commands = list(args.command or [])
+    command_file = args.command_file or config.get("command_file")
+    if not commands and command_file:
+        with open(command_file) as f:
+            commands = [line for line in f.read().splitlines() if line.strip()]
+    if not commands and config.get("commands"):
+        commands = list(config["commands"])
+    if not commands and not args.install_accelerate:
+        raise ValueError("You must specify either a command, a command file, or --install_accelerate.")
+
+    parts = []
+    if args.install_accelerate:
+        if args.accelerate_version == "latest":
+            parts.append("pip install -U accelerate-tpu")
+        else:
+            parts.append(f"pip install accelerate-tpu=={args.accelerate_version}")
+    parts += commands
+    return "; ".join(parts)
+
+
+def run(args) -> int:
+    # load_config_from_file already handles the ACCELERATE_CONFIG_FILE env
+    # var, the default path, and missing files (→ {})
+    config = load_config_from_file(args.config_file)
+    tpu_name = args.tpu_name or config.get("tpu_name")
+    tpu_zone = args.tpu_zone or config.get("tpu_zone")
+    if not tpu_name or not tpu_zone:
+        raise ValueError("tpu-config needs --tpu_name and --tpu_zone (or a config file providing them).")
+    command = assemble_pod_setup_command(args, config)
+    cmd = build_gcloud_ssh_cmd(tpu_name, tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha)
+    if args.debug:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    result = subprocess.run(cmd)
+    if result.returncode == 0:
+        print("Successfully ran the commands on the pod.")
+    return result.returncode

@@ -42,3 +42,14 @@ class RegressionModel:
 
         pred = RegressionModel.apply(params, batch["x"])
         return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def device_count_smoke(expected: int) -> None:
+    """Module-level payload for debug_launcher tests (must be picklable)."""
+    import jax
+
+    assert jax.device_count() == expected, f"{jax.device_count()} != {expected}"
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    print(f"devices={state.num_devices}")

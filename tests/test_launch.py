@@ -1,0 +1,143 @@
+"""Pod launch / tpu-config command assembly + notebook/debug launchers
+(reference commands/launch.py:812-868, commands/tpu.py:90-157,
+launchers.py:38-258)."""
+
+import argparse
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands.cli import main as cli_main
+from accelerate_tpu.commands.pod import assemble_worker_command, build_gcloud_ssh_cmd
+from accelerate_tpu.commands.tpu import assemble_pod_setup_command
+
+
+def _pod_args(**over):
+    base = dict(
+        tpu_name="mypod", tpu_zone="us-central2-b", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, training_script="train.py", training_script_args=[],
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+def test_pod_worker_command_assembly():
+    cmd = assemble_worker_command(
+        _pod_args(env=["WANDB_MODE=offline"], workdir="/srv/job", mixed_precision="bf16",
+                  training_script_args=["--epochs", "3"])
+    )
+    assert cmd == (
+        "cd /srv/job; export WANDB_MODE=offline; export ACCELERATE_IN_TPU_POD=1; "
+        "accelerate-tpu launch --mixed_precision bf16 train.py --epochs 3"
+    )
+
+
+def test_pod_worker_command_sudo_and_quoting():
+    cmd = assemble_worker_command(_pod_args(use_sudo=True, training_script="my train.py"))
+    assert "sudo accelerate-tpu launch 'my train.py'" in cmd
+
+
+def test_pod_bad_env_raises():
+    with pytest.raises(ValueError, match="KEY=VALUE"):
+        assemble_worker_command(_pod_args(env=["NOVALUE"]))
+
+
+def test_gcloud_ssh_cmd():
+    cmd = build_gcloud_ssh_cmd("mypod", "us-central2-b", "echo hi", worker="0", use_alpha=True)
+    assert cmd == [
+        "gcloud", "alpha", "compute", "tpus", "tpu-vm", "ssh", "mypod",
+        "--zone", "us-central2-b", "--command", "echo hi", "--worker", "0",
+    ]
+
+
+def test_pod_launch_cli_debug_prints(capsys):
+    rc = cli_main([
+        "pod-launch", "--tpu_name", "mypod", "--tpu_zone", "us-central2-b",
+        "--debug", "train.py", "--", "--epochs", "1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gcloud compute tpus tpu-vm ssh mypod" in out
+    assert "accelerate-tpu launch train.py" in out
+
+
+def test_tpu_config_command_assembly(tmp_path):
+    f = tmp_path / "cmds.txt"
+    f.write_text("echo one\necho two\n")
+    args = argparse.Namespace(
+        config_file=None, command=None, command_file=str(f), tpu_name="p", tpu_zone="z",
+        worker="all", use_alpha=False, install_accelerate=True, accelerate_version="0.1.0",
+        debug=True,
+    )
+    cmd = assemble_pod_setup_command(args)
+    assert cmd == "pip install accelerate-tpu==0.1.0; echo one; echo two"
+
+
+def test_tpu_config_requires_some_command():
+    args = argparse.Namespace(
+        config_file=None, command=None, command_file=None, tpu_name="p", tpu_zone="z",
+        worker="all", use_alpha=False, install_accelerate=False, accelerate_version="latest",
+        debug=True,
+    )
+    with pytest.raises(ValueError, match="command"):
+        assemble_pod_setup_command(args)
+
+
+def test_tpu_config_cli_debug_prints(capsys):
+    rc = cli_main([
+        "tpu-config", "--tpu_name", "p", "--tpu_zone", "z", "--command", "echo hi", "--debug",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gcloud compute tpus tpu-vm ssh p" in out
+
+
+def test_notebook_launcher_runs_inline():
+    from accelerate_tpu import notebook_launcher
+
+    result = notebook_launcher(lambda a, b: a + b, args=(2, 3), mixed_precision="bf16")
+    assert result == 5
+
+
+def test_notebook_launcher_rejects_bad_precision():
+    from accelerate_tpu import notebook_launcher
+
+    with pytest.raises(ValueError, match="mixed_precision"):
+        notebook_launcher(lambda: None, mixed_precision="int8")
+
+
+def test_debug_launcher_simulates_devices():
+    from accelerate_tpu import debug_launcher
+    from accelerate_tpu.test_utils.training import device_count_smoke
+
+    out = debug_launcher(device_count_smoke, args=(4,), num_processes=4)
+    assert "devices=4" in out
+
+
+def test_tpu_config_honors_env_config_file(tmp_path, monkeypatch, capsys):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("tpu_name: envpod\ntpu_zone: envzone\ncommands:\n  - echo from-env\n")
+    monkeypatch.setenv("ACCELERATE_CONFIG_FILE", str(cfg))
+    rc = cli_main(["tpu-config", "--debug"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "envpod" in out and "echo from-env" in out
+
+
+def test_debug_launcher_main_defined_function(tmp_path):
+    script = tmp_path / "train_debug.py"
+    script.write_text(
+        "from accelerate_tpu import debug_launcher\n"
+        "def my_fn(n):\n"
+        "    import jax\n"
+        "    assert jax.device_count() == n\n"
+        "    print(f'main-fn devices={jax.device_count()}')\n"
+        "if __name__ == '__main__':\n"
+        "    out = debug_launcher(my_fn, args=(2,), num_processes=2)\n"
+        "    print(out)\n"
+    )
+    result = subprocess.run([sys.executable, str(script)], capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "main-fn devices=2" in result.stdout
