@@ -363,6 +363,17 @@ class Accelerator:
                 from .parallel.ring_attention import make_ring_attention
 
                 model.attention_fn = make_ring_attention(self.mesh)
+            elif (
+                self.compilation_config.flash_attention_min_seq
+                and jax.default_backend() == "tpu"
+            ):
+                # long sequences stream through the Pallas flash kernel; short
+                # ones keep the XLA einsum path (per-shape dispatch)
+                from .ops.flash_attention import make_auto_attention
+
+                model.attention_fn = make_auto_attention(
+                    self.compilation_config.flash_attention_min_seq
+                )
             else:
                 model.attention_fn = None
         if self.state.mixed_precision == "fp8":

@@ -12,6 +12,7 @@ reference commands/tpu.py:90-157).
 
 from __future__ import annotations
 
+import re
 import shlex
 import subprocess
 
@@ -38,8 +39,10 @@ def register_subcommand(subparsers):
     )
     parser.add_argument("--mixed_precision", default=None)
     parser.add_argument("--num_processes", type=int, default=None, help="Total host count (optional; auto-detected on pods)")
+    from .launch import argparse_remainder
+
     parser.add_argument("training_script")
-    parser.add_argument("training_script_args", nargs="...", default=[])
+    parser.add_argument("training_script_args", nargs=argparse_remainder())
     parser.set_defaults(func=run)
     return parser
 
@@ -57,6 +60,8 @@ def assemble_worker_command(args) -> str:
         if "=" not in item:
             raise ValueError(f"--env expects KEY=VALUE, got {item!r}")
         key, _, value = item.partition("=")
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", key):
+            raise ValueError(f"--env key {key!r} is not a valid environment variable name")
         parts.append(f"export {key}={shlex.quote(value)}")
 
     launch = []

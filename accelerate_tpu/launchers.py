@@ -54,14 +54,20 @@ def notebook_launcher(
         )
     if mixed_precision not in ("no", "fp16", "bf16", "fp8"):
         raise ValueError(f"Unknown mixed_precision {mixed_precision!r}")
+    import jax
+
+    if num_processes is not None and num_processes != jax.device_count():
+        logger.warning(
+            f"notebook_launcher: num_processes={num_processes} requested but this "
+            f"runtime has {jax.device_count()} device(s); running on what exists "
+            "(the argument is reference-API parity, not a spawn count)."
+        )
     previous = os.environ.get("ACCELERATE_MIXED_PRECISION")
     os.environ["ACCELERATE_MIXED_PRECISION"] = mixed_precision
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
     try:
-        import jax
-
         logger.info(f"Launching training on {jax.device_count()} devices (one process).")
         return function(*args)
     finally:

@@ -1,0 +1,321 @@
+"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+
+The einsum path (models/attention.py) materializes the [B, N, S, S] score
+matrix in HBM — at seq 1024, bs 32 that single buffer is ~1.6 GB fp32 per
+layer and caps the trainable batch. This kernel streams K/V blocks through
+VMEM with an online softmax, so attention memory is O(S·D) per core instead
+of O(S²), forward AND backward (the backward recomputes P blockwise from the
+saved logsumexp — the standard flash-attention recipe).
+
+Layout notes (MXU/VMEM-first):
+- operates on [B, N, S, D] (heads made a leading grid dim; the wrapper
+  transposes from the model-zoo [B, S, N, D]);
+- the query axis is the grid's innermost dim: each program owns one
+  (batch, head, q-block) and loops over k-blocks ≤ its causal limit;
+- all matmuls run with fp32 accumulation; running max/denominator in fp32.
+
+v1 scope: causal self-attention, no padding mask (the wrapper falls back to
+the einsum path when a mask is present), full K/V of one head resident in
+VMEM (fine to ~8k tokens at D=64..128). GQA is handled by a K/V index map
+(q head h reads kv head h // group) — no repetition in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale, seq_len):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+    bq, d = q.shape
+
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    num_kb = seq_len // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        def attend(args):
+            m, l, acc = args
+            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [BQ, BK]
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            correction = jnp.exp(m - m_new)
+            l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * correction + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return m_new, l_new, acc_new
+
+        # causal: k-blocks entirely above the diagonal contribute nothing
+        return jax.lax.cond(j * block_k <= iq * block_q + bq - 1, attend, lambda a: a, (m, l, acc))
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    # lse broadcast over 8 sublanes: [B,N,S,8] satisfies TPU tiling while
+    # costing 8x a scalar row (vs the 128-lane layout jax's kernel uses)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (bq, 8))
+
+
+def _flash_forward(q, k, v, *, block_q, block_k, scale):
+    b, n, s, d = q.shape
+    kv_heads = k.shape[1]
+    group = n // kv_heads
+    grid = (b, n, s // block_q)
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, s, d), lambda bi, ni, qi: (bi, ni // group, 0, 0), memory_space=pltpu.VMEM
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale, seq_len=s
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 8), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, s, 8), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_q, block_k, scale, seq_len):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, :1]  # [BQ, 1] (sublane-broadcast storage)
+    delta = delta_ref[0, 0][:, :1]
+    bq, d = q.shape
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, dq):
+        def attend(dq):
+            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = scale * jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            p = jnp.exp(s - lse)  # [BQ, BK]
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta) * scale
+            return dq + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+        return jax.lax.cond(j * block_k <= iq * block_q + bq - 1, attend, lambda x: x, dq)
+
+    dq = jax.lax.fori_loop(0, seq_len // block_k, body, dq)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, block_k, scale, seq_len, group):
+    ik = pl.program_id(2)
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k_blk.shape
+
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    def q_block_loop(args):
+        dk, dv, g = args
+
+        def body(jq, carry):
+            dk, dv = carry
+
+            def attend(carry):
+                dk, dv = carry
+                q = q_ref[0, g, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+                do = do_ref[0, g, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+                lse = lse_ref[0, g, pl.ds(jq * block_q, block_q), :][:, :1]
+                delta = delta_ref[0, g, pl.ds(jq * block_q, block_q), :][:, :1]
+                s = scale * jax.lax.dot_general(
+                    q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )  # [BQ, BK]
+                q_pos = jq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+                s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+                p = jnp.exp(s - lse)
+                dv_new = dv + jax.lax.dot_general(
+                    p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                dp = jax.lax.dot_general(
+                    do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                ds = p * (dp - delta) * scale
+                dk_new = dk + jax.lax.dot_general(
+                    ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                return dk_new, dv_new
+
+            # causal: q blocks strictly above this k block see none of it
+            return jax.lax.cond((jq + 1) * block_q - 1 >= ik * block_k, attend, lambda c: c, (dk, dv))
+
+        return jax.lax.fori_loop(0, seq_len // block_q, body, (dk, dv))
+
+    for g_off in range(group):  # static loop over the q heads sharing this kv head
+        dk, dv = q_block_loop((dk, dv, g_off))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, *, block_q, block_k, scale):
+    q, k, v, out, lse = res
+    b, n, s, d = q.shape
+    kv_heads = k.shape[1]
+    group = n // kv_heads
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)  # [B, N, S]
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 8))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM)
+    kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, ni, qi: (bi, ni // group, 0, 0), memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, block_q, 8), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=block_q, block_k=block_k, scale=scale, seq_len=s
+        ),
+        grid=(b, n, s // block_q),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    # one program per (batch, kv head, k block); its q-head group is looped
+    # inside, so dk/dv accumulate without cross-program races
+    kv_blk_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, ki, kbi: (bi, ki, kbi, 0), memory_space=pltpu.VMEM)
+    qhead_group = pl.BlockSpec(
+        (1, group, s, d), lambda bi, ki, kbi: (bi, ki, 0, 0), memory_space=pltpu.VMEM
+    )
+    rows_group = pl.BlockSpec((1, group, s, 8), lambda bi, ki, kbi: (bi, ki, 0, 0), memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, block_k=block_k, scale=scale, seq_len=s, group=group
+        ),
+        grid=(b, kv_heads, s // block_k),
+        in_specs=[qhead_group, kv_blk_spec, kv_blk_spec, qhead_group, rows_group, rows_group],
+        out_specs=[kv_blk_spec, kv_blk_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv_heads, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kv_heads, s, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_bnsd(q, k, v, block_q, block_k, scale):
+    out, _ = _flash_forward(q, k, v, block_q=block_q, block_k=block_k, scale=scale)
+    return out
+
+
+def _fwd_rule(q, k, v, block_q, block_k, scale):
+    out, lse = _flash_forward(q, k, v, block_q=block_q, block_k=block_k, scale=scale)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(block_q, block_k, scale, res, g):
+    return _flash_backward(res, g, block_q=block_q, block_k=block_k, scale=scale)
+
+
+_flash_attention_bnsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, N, D] (model-zoo layout)
+    k: jax.Array,  # [B, S, KV, D]
+    v: jax.Array,  # [B, S, KV, D]
+    kv_mask: Optional[jax.Array] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Causal flash attention with the ``attention_fn`` hook signature.
+
+    Falls back to the einsum path when a padding mask is present or when the
+    sequence does not tile (v1 scope).
+    """
+    b, s, n, d = q.shape
+    if kv_mask is not None or s % block_q or s % block_k or s < max(block_q, block_k):
+        from ..models.attention import dot_product_attention
+
+        mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)
+        return dot_product_attention(q, k, v, mask=mask, causal=True)
+    scale = 1.0 / math.sqrt(d)
+    out = _flash_attention_bnsd(
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), block_q, block_k, scale
+    )
+    return out.swapaxes(1, 2)
+
+
+def make_auto_attention(min_seq: int = 2048):
+    """Per-shape dispatch: the flash kernel beats XLA's fused einsum attention
+    from ~2k tokens (measured on v5e: +18% MFU at 4k; −20% at 1k, where the
+    kernel's constant factors lose to XLA's fusion) — so short sequences keep
+    the einsum path and long ones stream through the kernel."""
+
+    def attention(q, k, v, kv_mask=None):
+        if q.shape[1] >= min_seq:
+            return flash_attention(q, k, v, kv_mask)  # self-falls-back on mask
+        from ..models.attention import dot_product_attention
+
+        mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)
+        return dot_product_attention(q, k, v, mask=mask, causal=True)
+
+    return attention

@@ -95,6 +95,12 @@ class PartialState:
                     num_processes=num_processes,
                     process_id=process_id,
                 )
+        elif parse_flag_from_env("ACCELERATE_IN_TPU_POD"):
+            # pod-launch path: no explicit coordinator — every worker runs the
+            # identical command and jax self-discovers coordinator/process_id/
+            # process count from the TPU pod metadata (argless initialize)
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize()
         self.backend = "xla"
         self.device = jax.local_devices()[0]
         self.initialized = True

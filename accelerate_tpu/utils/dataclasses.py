@@ -324,6 +324,9 @@ class CompilationConfig:
     donate_params: bool = True
     remat_policy: Optional[str] = None  # None | "full" | "dots" | "dots_saveable" | "nothing_saveable"
     use_scan_layers: bool = True  # roll transformer layers into lax.scan (compile-time win)
+    # sequences at least this long route causal attention through the Pallas
+    # flash kernel (ops/flash_attention.py) on TPU; 0 disables
+    flash_attention_min_seq: int = 2048
 
     def checkpoint_policy(self) -> Optional[Callable]:
         import jax

@@ -131,6 +131,27 @@ def bench_bert_training() -> dict:
 def bench_llama_fsdp() -> dict:
     """BASELINE target #2: llama-family FSDP training MFU, sized to one chip
     (fsdp axis spans whatever devices exist; activation checkpointing on)."""
+    return _llama_train_bench(
+        name=os.environ.get("BENCH_LLAMA", "llama-125m"),
+        batch_size=int(os.environ.get("BENCH_LLAMA_BS", "32")),
+        seq_len=1024,
+        n_steps=10,
+        prefix="llama_fsdp",
+        include_model_key=True,
+    )
+
+
+def bench_llama_longseq() -> dict:
+    """Long-context training throughput: seq 4096 routes attention through
+    the Pallas flash kernel (ops/flash_attention.py) — same per-step tokens
+    as the seq-1024 run, S² attention memory gone."""
+    return _llama_train_bench(
+        name="llama-125m", batch_size=8, seq_len=4096, n_steps=8, prefix="llama_seq4096"
+    )
+
+
+def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model_key=False) -> dict:
+    """Shared harness: FSDP llama training throughput + MFU at a given shape."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -139,13 +160,11 @@ def bench_llama_fsdp() -> dict:
     from accelerate_tpu.models import Llama
 
     _reset_state()
-    n = jax.device_count()
     accelerator = Accelerator(
         mixed_precision="bf16",
-        parallelism=ParallelismConfig(data=1, fsdp=n),
+        parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
         fsdp_plugin=FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=True),
     )
-    name = os.environ.get("BENCH_LLAMA", "llama-125m")
     model = Llama(name)
     accelerator.prepare_model(model)
     accelerator.prepare_optimizer(optax.adamw(3e-4))
@@ -157,32 +176,32 @@ def bench_llama_fsdp() -> dict:
         return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
 
     step = accelerator.compiled_step(loss_fn)
-    batch_size, seq_len = int(os.environ.get("BENCH_LLAMA_BS", "32")), 1024
     rng = np.random.default_rng(0)
-    sharding = accelerator.state.data_sharding()
     batch = {
         "input_ids": jax.device_put(
-            jnp.asarray(rng.integers(0, model.config.vocab_size, (batch_size, seq_len)), jnp.int32), sharding
+            jnp.asarray(rng.integers(0, model.config.vocab_size, (batch_size, seq_len)), jnp.int32),
+            accelerator.state.data_sharding(),
         )
     }
     for _ in range(3):
         loss = step(batch)
     float(loss)
-    n_steps = 10
     start = time.perf_counter()
     for _ in range(n_steps):
         loss = step(batch)
     float(loss)
     elapsed = time.perf_counter() - start
     steps_per_sec = n_steps / elapsed
-    result = {
-        "llama_fsdp_model": name,
-        "llama_fsdp_tokens_per_sec_per_chip": round(steps_per_sec * batch_size * seq_len / jax.device_count(), 1),
-    }
+    result = {}
+    if include_model_key:
+        result[f"{prefix}_model"] = name
+    result[f"{prefix}_tokens_per_sec_per_chip"] = round(
+        steps_per_sec * batch_size * seq_len / jax.device_count(), 1
+    )
     peak = _chip_peak_flops()
     if peak is not None:
         flops = _train_flops_per_step(model.config, batch_size, seq_len)
-        result["llama_fsdp_train_mfu"] = round(flops * steps_per_sec / (peak * jax.device_count()), 4)
+        result[f"{prefix}_train_mfu"] = round(flops * steps_per_sec / (peak * jax.device_count()), 4)
     return result
 
 
@@ -252,7 +271,7 @@ def main() -> None:
     errors: dict = {}
     primary = bench_bert_training()
     extra.update(primary)
-    for fn in (bench_llama_fsdp, bench_big_model_inference):
+    for fn in (bench_llama_fsdp, bench_llama_longseq, bench_big_model_inference):
         try:
             extra.update(fn())
         except Exception as e:  # a sub-bench must not take down the primary metric

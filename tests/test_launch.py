@@ -63,7 +63,8 @@ def test_pod_launch_cli_debug_prints(capsys):
     assert "accelerate-tpu launch train.py" in out
 
 
-def test_tpu_config_command_assembly(tmp_path):
+def test_tpu_config_command_assembly(tmp_path, monkeypatch):
+    monkeypatch.delenv("ACCELERATE_CONFIG_FILE", raising=False)
     f = tmp_path / "cmds.txt"
     f.write_text("echo one\necho two\n")
     args = argparse.Namespace(
@@ -71,18 +72,19 @@ def test_tpu_config_command_assembly(tmp_path):
         worker="all", use_alpha=False, install_accelerate=True, accelerate_version="0.1.0",
         debug=True,
     )
-    cmd = assemble_pod_setup_command(args)
+    cmd = assemble_pod_setup_command(args, config={})
     assert cmd == "pip install accelerate-tpu==0.1.0; echo one; echo two"
 
 
-def test_tpu_config_requires_some_command():
+def test_tpu_config_requires_some_command(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_CONFIG_FILE", raising=False)
     args = argparse.Namespace(
         config_file=None, command=None, command_file=None, tpu_name="p", tpu_zone="z",
         worker="all", use_alpha=False, install_accelerate=False, accelerate_version="latest",
         debug=True,
     )
     with pytest.raises(ValueError, match="command"):
-        assemble_pod_setup_command(args)
+        assemble_pod_setup_command(args, config={})
 
 
 def test_tpu_config_cli_debug_prints(capsys):
