@@ -15,6 +15,7 @@ from .big_modeling import (
     disk_offload,
     dispatch_model,
     init_empty_weights,
+    load_and_quantize_model,
     load_checkpoint_and_dispatch,
 )
 from .data_loader import prepare_data_loader, skip_first_batches

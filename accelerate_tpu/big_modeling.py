@@ -58,6 +58,13 @@ def init_empty_weights(model) -> Any:
 init_on_device = init_empty_weights  # parity alias
 
 
+def _device_put_packed(buf):
+    """One DMA per buffer; quantized layers are (int8 data, fp sidecar) pairs."""
+    if isinstance(buf, tuple):
+        return tuple(jax.device_put(jnp.asarray(part)) for part in buf)
+    return jax.device_put(jnp.asarray(buf))
+
+
 def _unflatten(flat: dict[str, Any]) -> dict:
     out: dict = {}
     for key, value in flat.items():
@@ -133,8 +140,8 @@ class _LayerStreamer:
         self.dtype = dtype
         self.hf_device_map: dict[str, str] = {}
 
-    def _put(self, buf) -> jax.Array:
-        return jax.device_put(jnp.asarray(buf))  # single contiguous DMA
+    def _put(self, buf):
+        return _device_put_packed(buf)
 
     def _iter_device_layers(self):
         """Yield each layer's packed device buffer, double-buffering transfers."""
@@ -150,6 +157,86 @@ class _LayerStreamer:
             if j < L and not self.layer_on_device[j]:
                 next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
             yield current
+
+
+class QuantizedLayerPacker:
+    """Layer packer with weight-only int8/int4 quantization (reference
+    utils/bnb.py:44 load_and_quantize_model): matrix leaves are quantized per
+    output channel into one contiguous int8 buffer; vectors (norms, biases)
+    and the per-channel scales ride in a float32 sidecar buffer. ``unpack``
+    dequantizes on device inside the jitted layer program (W8A16/W4A16)."""
+
+    def __init__(self, stacked_layers: Any, dtype, bits: int = 8, skip: Optional[list[str]] = None):
+        from .utils.quantization import quantize_weight  # noqa: F401 - used in pack
+
+        self.dtype = dtype
+        self.bits = bits
+        skip = skip or []
+        self.shapes: dict[str, tuple] = {
+            key: tuple(leaf.shape[1:]) for key, leaf in _flat_items(stacked_layers)
+        }
+        self.quant_keys = [
+            k for k, shape in self.shapes.items() if len(shape) >= 2 and not any(s in k for s in skip)
+        ]
+        self.full_keys = [k for k in self.shapes if k not in self.quant_keys]
+
+        self.q_offsets: dict[str, tuple[int, int]] = {}
+        offset = 0
+        for key in self.quant_keys:
+            shape = self.shapes[key]
+            size = int(np.prod(shape))
+            if bits == 4:
+                size //= 2
+            self.q_offsets[key] = (offset, size)
+            offset += size
+        self.q_total = offset
+
+        self.f_offsets: dict[str, tuple[int, int]] = {}
+        offset = 0
+        for key in self.full_keys:
+            size = int(np.prod(self.shapes[key])) if self.shapes[key] else 1
+            self.f_offsets[key] = (offset, size)
+            offset += size
+        for key in self.quant_keys:  # per-output-channel scales
+            size = self.shapes[key][-1]
+            self.f_offsets[f"{key}@scale"] = (offset, size)
+            offset += size
+        self.f_total = offset
+
+    def pack(self, layer: Mapping[str, Any]):
+        from .utils.quantization import quantize_weight
+
+        flat = dict(_flat_items(layer))
+        qbuf = np.empty((self.q_total,), np.int8)
+        fbuf = np.empty((self.f_total,), np.float32)
+        for key in self.quant_keys:
+            q, scale = quantize_weight(np.asarray(flat[key]), bits=self.bits)
+            offset, size = self.q_offsets[key]
+            qbuf[offset : offset + size] = q.ravel()
+            f_off, f_size = self.f_offsets[f"{key}@scale"]
+            fbuf[f_off : f_off + f_size] = scale
+        for key in self.full_keys:
+            offset, size = self.f_offsets[key]
+            fbuf[offset : offset + size] = np.asarray(flat[key], np.float32).ravel()
+        return (qbuf, fbuf)
+
+    def unpack(self, bufs) -> dict:
+        from .utils.quantization import dequantize_weight
+
+        qbuf, fbuf = bufs
+        out = {}
+        for key in self.quant_keys:
+            shape = self.shapes[key]
+            offset, size = self.q_offsets[key]
+            stored_shape = (shape[0] // 2,) + shape[1:] if self.bits == 4 else shape
+            q = qbuf[offset : offset + size].reshape(stored_shape)
+            f_off, f_size = self.f_offsets[f"{key}@scale"]
+            scale = fbuf[f_off : f_off + f_size]
+            out[key] = dequantize_weight(q, scale, self.bits, self.dtype)
+        for key in self.full_keys:
+            offset, size = self.f_offsets[key]
+            out[key] = fbuf[offset : offset + size].reshape(self.shapes[key]).astype(self.dtype)
+        return _unflatten(out)
 
 
 class StreamedCausalLM(_LayerStreamer):
@@ -332,7 +419,7 @@ class StreamedModel(_LayerStreamer):
         return self.model.stream_suffix(resident, carry)
 
 
-def _place_components(params, device_map, offload_dir, dtype):
+def _place_components(params, device_map, offload_dir, dtype, quantization=None):
     """Shared placement: resident leaves + packed per-layer buffers."""
     np_dtype = np.asarray(jnp.zeros((), dtype)).dtype
 
@@ -356,18 +443,36 @@ def _place_components(params, device_map, offload_dir, dtype):
         else:
             raise ValueError(f"Unknown target {target!r} for {key}")
 
-    packer = LayerPacker(params["layers"], dtype)
+    if quantization is not None:
+        packer: Any = QuantizedLayerPacker(
+            params["layers"], dtype, bits=quantization.bits, skip=quantization.skip_modules
+        )
+    else:
+        packer = LayerPacker(params["layers"], dtype)
     stacked = {k: np.asarray(v) for k, v in _flat_items(params["layers"])}
     num_layers = next(iter(stacked.values())).shape[0]
     layer_buffers: list[Any] = []
     layer_on_device: list[bool] = []
     disk_index: dict = {}
+
+    def _to_disk(packed, name):
+        nonlocal disk_index
+        parts = packed if isinstance(packed, tuple) else (packed,)
+        loaded = []
+        for j, part in enumerate(parts):
+            part_name = f"{name}.{j}" if len(parts) > 1 else name
+            disk_index = offload_weight(part, part_name, offload_dir, disk_index)
+            loaded.append(
+                load_offloaded_weight(os.path.join(offload_dir, f"{part_name}.dat"), disk_index[part_name])
+            )
+        return tuple(loaded) if isinstance(packed, tuple) else loaded[0]
+
     for i in range(num_layers):
         layer = {k: v[i] for k, v in stacked.items()}
         target = device_map.get(f"layers.{i}", "device")
         packed = packer.pack(layer)
         if target == "device":
-            layer_buffers.append(jax.device_put(jnp.asarray(packed)))
+            layer_buffers.append(_device_put_packed(packed))
             layer_on_device.append(True)
         elif target == "cpu":
             layer_buffers.append(packed)
@@ -376,11 +481,7 @@ def _place_components(params, device_map, offload_dir, dtype):
             if offload_dir is None:
                 raise ValueError("device_map places layers on disk — pass offload_dir")
             os.makedirs(offload_dir, exist_ok=True)
-            name = f"layers.{i}.packed"
-            disk_index = offload_weight(packed, name, offload_dir, disk_index)
-            layer_buffers.append(
-                load_offloaded_weight(os.path.join(offload_dir, f"{name}.dat"), disk_index[name])
-            )
+            layer_buffers.append(_to_disk(packed, f"layers.{i}.packed"))
             layer_on_device.append(False)
         else:
             raise ValueError(f"Unknown target {target!r} for layers.{i}")
@@ -396,6 +497,7 @@ def dispatch_model(
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
+    quantization=None,  # utils.quantization.QuantizationConfig → W8A16/W4A16 layers
 ):
     """Place components per ``device_map`` and return the streaming executor.
 
@@ -410,13 +512,17 @@ def dispatch_model(
             "protocol (stream_prefix/stream_layer/stream_suffix) or use a "
             "llama-family model."
         )
-    dtype_bytes = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
+    dtype_bytes: float = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
+    if quantization is not None:
+        # auto placement must size layers at their QUANTIZED footprint, or
+        # device-resident capacity is underestimated by 2-4x
+        dtype_bytes = quantization.bits / 8
     if isinstance(device_map, str):
         device_map = infer_auto_device_map(model, max_memory=max_memory, dtype_bytes=dtype_bytes)
     check_device_map(model, device_map)
 
     resident, packer, layer_buffers, layer_on_device = _place_components(
-        params, device_map, offload_dir, dtype
+        params, device_map, offload_dir, dtype, quantization=quantization
     )
 
     if isinstance(model, Llama):
@@ -463,4 +569,34 @@ def load_checkpoint_and_dispatch(
     params = load_checkpoint_in_model(model, checkpoint)
     return dispatch_model(
         model, params, device_map=device_map, max_memory=max_memory, offload_dir=offload_dir, dtype=dtype
+    )
+
+
+def load_and_quantize_model(
+    model: Any,
+    quantization_config,
+    weights_location: Optional[str] = None,
+    params: Any = None,
+    device_map: dict[str, str] | str = "auto",
+    max_memory: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+    dtype=jnp.bfloat16,
+):
+    """Reference utils/bnb.py:44 — load a checkpoint and dispatch with layer
+    weights quantized to int8/int4 (per-output-channel scales, dequantized on
+    device inside the jitted layer program)."""
+    if params is None:
+        if weights_location is None:
+            raise ValueError("Pass weights_location (a checkpoint) or params.")
+        from .utils.hf_import import load_checkpoint_in_model
+
+        params = load_checkpoint_in_model(model, weights_location)
+    return dispatch_model(
+        model,
+        params,
+        device_map=device_map,
+        max_memory=max_memory,
+        offload_dir=offload_dir,
+        dtype=dtype,
+        quantization=quantization_config,
     )

@@ -293,12 +293,102 @@ class AimTracker(GeneralTracker):
         self.writer.close()
 
 
+@register_tracker
+class ClearMLTracker(GeneralTracker):
+    """ClearML (reference tracking.py:724-873)."""
+
+    name = "clearml"
+
+    def __init__(self, run_name: str, **kwargs):
+        from clearml import Task
+
+        self.run_name = run_name
+        existing = Task.current_task()
+        self.task = existing or Task.init(project_name=run_name, **kwargs)
+        # only close tasks we created; an adopted external task stays open
+        self._created = existing is None
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        logger_obj = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                if step is None:
+                    logger_obj.report_single_value(name=k, value=v, **kwargs)
+                else:
+                    title, _, series = k.partition("/")
+                    logger_obj.report_scalar(
+                        title=title, series=series or title, value=v, iteration=step, **kwargs
+                    )
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        logger_obj = self.task.get_logger()
+        for k, v in values.items():
+            logger_obj.report_image(title=k, series=k, iteration=step, image=v, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name: str, columns=None, data=None, step: Optional[int] = None, **kwargs) -> None:
+        to_report = [columns] + list(data) if columns is not None else data
+        self.task.get_logger().report_table(
+            title=table_name, series=table_name, table_plot=to_report, iteration=step, **kwargs
+        )
+
+    @on_main_process
+    def finish(self) -> None:
+        if self._created:
+            self.task.close()
+
+    @property
+    def tracker(self):
+        return self.task
+
+
+@register_tracker
+class DVCLiveTracker(GeneralTracker):
+    """DVCLive (reference tracking.py:876-968)."""
+
+    name = "dvclive"
+
+    def __init__(self, run_name: str, live=None, **kwargs):  # noqa: ARG002 - run_name unused upstream too
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if isinstance(v, (int, float, str)):
+                self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
+
+    @property
+    def tracker(self):
+        return self.live
+
+
 _AVAILABILITY = {
     "tensorboard": is_tensorboard_available,
     "wandb": is_wandb_available,
     "mlflow": is_mlflow_available,
     "comet_ml": is_comet_ml_available,
     "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
     "jsonl": lambda: True,
 }
 

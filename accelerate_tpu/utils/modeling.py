@@ -32,14 +32,15 @@ def dtype_byte_size(dtype) -> float:
     return np.dtype(dtype).itemsize if not str(dtype).startswith("float8") else 1
 
 
-def named_component_sizes(model, dtype_bytes: int = 4) -> dict[str, int]:
-    """Per-placement-component parameter bytes, from shapes only (no alloc)."""
+def named_component_sizes(model, dtype_bytes: float = 4) -> dict[str, int]:
+    """Per-placement-component parameter bytes, from shapes only (no alloc).
+    ``dtype_bytes`` may be fractional (int4 = 0.5)."""
     cfg: TransformerConfig = model.config
     shapes = jax.eval_shape(model.init, jax.random.key(0))
     sizes: dict[str, int] = {}
     layer_total = 0
     for key, leaf in _iter_flat(shapes):
-        nbytes = int(np.prod(leaf.shape)) * dtype_bytes
+        nbytes = int(int(np.prod(leaf.shape)) * dtype_bytes)
         if key.startswith("layers/"):
             layer_total += nbytes
         else:

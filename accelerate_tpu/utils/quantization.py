@@ -1,0 +1,69 @@
+"""Weight-only int8/int4 quantization for big-model inference.
+
+Parity: reference utils/bnb.py (``load_and_quantize_model``, :44;
+``BnbQuantizationConfig``, dataclasses.py:1594) — bitsandbytes' CUDA int8/int4
+linears, rebuilt TPU-style: weights are quantized **per output channel** on
+the host, streamed/stored as int8 (or nibble-packed int4), and dequantized to
+the compute dtype on device inside the jitted layer program (W8A16 /
+W4A16). The matmuls stay bf16 on the MXU — the win is 2×/4× less host RAM,
+disk, and H2D bandwidth for streamed layers, which is exactly what bounds
+big-model per-token latency (reference benchmarks/README.md:39-42).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference BnbQuantizationConfig surface, TPU semantics."""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    skip_modules: Optional[list[str]] = None  # leaf-name substrings kept full precision
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("Pick one of load_in_8bit / load_in_4bit.")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("QuantizationConfig needs load_in_8bit or load_in_4bit.")
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+
+def quantize_weight(w: np.ndarray, bits: int = 8) -> tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel (last axis) symmetric quantization.
+
+    Returns (q, scale): int8 values (int4 packed two-per-byte on the first
+    axis) and a float32 scale of shape ``w.shape[-1:]``.
+    """
+    w = np.asarray(w, np.float32)
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = np.abs(w).max(axis=tuple(range(w.ndim - 1))) / qmax
+    scale = np.maximum(scale, 1e-12).astype(np.float32)
+    q = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
+    if bits == 4:
+        if q.shape[0] % 2:
+            raise ValueError("int4 packing needs an even leading dim")
+        low = q[0::2] & 0x0F
+        high = (q[1::2] & 0x0F) << 4
+        q = (low | high).astype(np.int8)
+    return q, scale
+
+
+def dequantize_weight(q: jax.Array, scale: jax.Array, bits: int, dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of ``quantize_weight`` — runs on device inside jit."""
+    if bits == 4:
+        low = (q << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
+        high = q >> 4  # arithmetic shift sign-extends the high nibble
+        q = jnp.stack([low, high], axis=1).reshape((-1,) + q.shape[1:])
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,133 @@
+"""int8/int4 weight-only quantization (reference utils/bnb.py:44,
+tests/test_quantization.py): quantize/dequantize bounds, packed streaming
+dispatch parity, memory halving, and the load_and_quantize_model entry."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.big_modeling import QuantizedLayerPacker, dispatch_model
+from accelerate_tpu.checkpointing import save_model_weights
+from accelerate_tpu.models import Llama
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    dequantize_weight,
+    quantize_weight,
+)
+
+
+def test_quantize_roundtrip_int8():
+    w = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+    q, scale = quantize_weight(w, bits=8)
+    assert q.dtype == np.int8 and scale.shape == (32,)
+    back = np.asarray(dequantize_weight(jnp.asarray(q), jnp.asarray(scale), 8, jnp.float32))
+    # symmetric per-channel int8: error bounded by scale/2 per element
+    assert np.abs(back - w).max() <= (scale.max() / 2) + 1e-6
+
+
+def test_quantize_roundtrip_int4_packs_two_per_byte():
+    w = np.random.default_rng(1).normal(size=(64, 32)).astype(np.float32)
+    q, scale = quantize_weight(w, bits=4)
+    assert q.shape == (32, 32)  # nibble-packed on the leading axis
+    back = np.asarray(dequantize_weight(jnp.asarray(q), jnp.asarray(scale), 4, jnp.float32))
+    assert back.shape == w.shape
+    assert np.abs(back - w).max() <= (scale.max() / 2) + 1e-6
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        QuantizationConfig()
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    assert QuantizationConfig(load_in_8bit=True).bits == 8
+    assert QuantizationConfig(load_in_4bit=True).bits == 4
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Llama("llama-tiny")
+    params = jax.device_get(model.init(jax.random.key(0)))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (2, 12)), jnp.int32)
+    full = model.apply(jax.tree.map(jnp.asarray, params), ids)
+    return model, params, ids, full
+
+
+def test_quantized_dispatch_close_to_full(tiny):
+    model, params, ids, full = tiny
+    cfg = model.config
+    dm = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    dm.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
+    lm = dispatch_model(
+        model, params, dm, dtype=jnp.float32, quantization=QuantizationConfig(load_in_8bit=True)
+    )
+    got = lm(ids)
+    # int8 weights: logits close but not exact
+    rel = np.abs(np.asarray(got) - np.asarray(full)).max() / np.abs(np.asarray(full)).max()
+    assert rel < 0.05
+    assert not np.array_equal(np.asarray(got), np.asarray(full))
+    # top-1 predictions overwhelmingly preserved (random-init logits are
+    # near-uniform, so a few positions may legitimately flip)
+    agree = (np.argmax(np.asarray(got), -1) == np.argmax(np.asarray(full), -1)).mean()
+    assert agree >= 0.9
+
+
+def test_quantized_buffers_halve_memory(tiny):
+    model, params, ids, _ = tiny
+    cfg = model.config
+    dm = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    dm.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
+    full = dispatch_model(model, params, dm, dtype=jnp.bfloat16)
+    q8 = dispatch_model(model, params, dm, dtype=jnp.bfloat16, quantization=QuantizationConfig(load_in_8bit=True))
+    q4 = dispatch_model(model, params, dm, dtype=jnp.bfloat16, quantization=QuantizationConfig(load_in_4bit=True))
+
+    def layer_bytes(lm):
+        buf = lm.layer_buffers[0]
+        parts = buf if isinstance(buf, tuple) else (buf,)
+        return sum(np.asarray(p).nbytes for p in parts)
+
+    assert layer_bytes(q8) < layer_bytes(full) * 0.62  # int8 + fp32 sidecar < bf16
+    assert layer_bytes(q4) < layer_bytes(q8) * 0.62
+
+
+def test_quantized_generate_runs(tiny):
+    model, params, ids, _ = tiny
+    from accelerate_tpu import load_and_quantize_model
+
+    lm = load_and_quantize_model(
+        model, QuantizationConfig(load_in_8bit=True), params=params, device_map="auto", dtype=jnp.float32
+    )
+    out = lm.generate(ids[:1, :4], max_new_tokens=4)
+    assert out.shape == (1, 8)
+
+
+def test_load_and_quantize_from_checkpoint(tmp_path, tiny):
+    model, params, ids, full = tiny
+    from accelerate_tpu import load_and_quantize_model
+
+    save_model_weights(params, str(tmp_path))
+    lm = load_and_quantize_model(
+        model, QuantizationConfig(load_in_8bit=True), weights_location=str(tmp_path),
+        device_map="auto", dtype=jnp.float32,
+    )
+    got = lm(ids)
+    rel = np.abs(np.asarray(got) - np.asarray(full)).max() / np.abs(np.asarray(full)).max()
+    assert rel < 0.05
+
+
+def test_quantized_disk_offload(tmp_path, tiny):
+    model, params, ids, full = tiny
+    cfg = model.config
+    dm = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    dm.update({f"layers.{i}": "disk" for i in range(cfg.num_layers)})
+    lm = dispatch_model(
+        model, params, dm, offload_dir=str(tmp_path), dtype=jnp.float32,
+        quantization=QuantizationConfig(load_in_8bit=True),
+    )
+    got = lm(ids)
+    rel = np.abs(np.asarray(got) - np.asarray(full)).max() / np.abs(np.asarray(full)).max()
+    assert rel < 0.05
+    import os
+
+    assert any(f.endswith(".dat") for f in os.listdir(tmp_path))

@@ -110,5 +110,5 @@ def test_log_images_fallback_warns_not_crashes():
 def test_trackers_registered():
     from accelerate_tpu.tracking import _available_trackers
 
-    for name in ("tensorboard", "wandb", "mlflow", "comet_ml", "aim", "jsonl"):
+    for name in ("tensorboard", "wandb", "mlflow", "comet_ml", "aim", "clearml", "dvclive", "jsonl"):
         assert name in _available_trackers
