@@ -513,12 +513,13 @@ def dispatch_model(
             "llama-family model."
         )
     dtype_bytes: float = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
-    if quantization is not None:
-        # auto placement must size layers at their QUANTIZED footprint, or
-        # device-resident capacity is underestimated by 2-4x
-        dtype_bytes = quantization.bits / 8
+    # auto placement sizes layers at their QUANTIZED footprint (resident
+    # components stay full precision), or capacity is mis-estimated 2-4x
+    layer_dtype_bytes = quantization.bits / 8 if quantization is not None else None
     if isinstance(device_map, str):
-        device_map = infer_auto_device_map(model, max_memory=max_memory, dtype_bytes=dtype_bytes)
+        device_map = infer_auto_device_map(
+            model, max_memory=max_memory, dtype_bytes=dtype_bytes, layer_dtype_bytes=layer_dtype_bytes
+        )
     check_device_map(model, device_map)
 
     resident, packer, layer_buffers, layer_on_device = _place_components(

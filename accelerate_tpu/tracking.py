@@ -304,7 +304,13 @@ class ClearMLTracker(GeneralTracker):
 
         self.run_name = run_name
         existing = Task.current_task()
-        self.task = existing or Task.init(project_name=run_name, **kwargs)
+        if existing is not None:
+            self.task = existing
+        else:
+            init_kwargs = dict(kwargs)
+            init_kwargs.setdefault("project_name", run_name)
+            init_kwargs.setdefault("task_name", run_name)
+            self.task = Task.init(**init_kwargs)
         # only close tasks we created; an adopted external task stays open
         self._created = existing is None
 

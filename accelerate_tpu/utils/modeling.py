@@ -32,19 +32,28 @@ def dtype_byte_size(dtype) -> float:
     return np.dtype(dtype).itemsize if not str(dtype).startswith("float8") else 1
 
 
-def named_component_sizes(model, dtype_bytes: float = 4) -> dict[str, int]:
+def named_component_sizes(
+    model, dtype_bytes: float = 4, layer_dtype_bytes: Optional[float] = None
+) -> dict[str, int]:
     """Per-placement-component parameter bytes, from shapes only (no alloc).
-    ``dtype_bytes`` may be fractional (int4 = 0.5)."""
+
+    ``layer_dtype_bytes`` sizes the streamed layers separately from the
+    resident components — weight-only quantization shrinks layers to 1 (int8)
+    or 0.5 (int4) bytes/weight while embed/head stay at the compute dtype.
+    (The quantized fp32 scale sidecar is ~1/hidden of the weights — ignored.)
+    """
     cfg: TransformerConfig = model.config
+    if layer_dtype_bytes is None:
+        layer_dtype_bytes = dtype_bytes
     shapes = jax.eval_shape(model.init, jax.random.key(0))
     sizes: dict[str, int] = {}
     layer_total = 0
     for key, leaf in _iter_flat(shapes):
-        nbytes = int(int(np.prod(leaf.shape)) * dtype_bytes)
+        count = int(np.prod(leaf.shape))
         if key.startswith("layers/"):
-            layer_total += nbytes
+            layer_total += int(count * layer_dtype_bytes)
         else:
-            sizes[key.replace("/", ".")] = nbytes
+            sizes[key.replace("/", ".")] = int(count * dtype_bytes)
     per_layer = layer_total // cfg.num_layers
     for i in range(cfg.num_layers):
         sizes[f"layers.{i}"] = per_layer
@@ -115,14 +124,15 @@ def _to_bytes(value) -> int:
 def infer_auto_device_map(
     model,
     max_memory: Optional[dict] = None,
-    dtype_bytes: int = 2,
+    dtype_bytes: float = 2,
+    layer_dtype_bytes: Optional[float] = None,
     no_split: bool = True,  # noqa: ARG001 - layers are never split further
 ) -> dict[str, str]:
     """Greedy packer (reference modeling.py:1071): fill "device" in forward
     order, then "cpu", then "disk" — keeping room on device for the largest
     streamed layer (it must fit to compute) plus double-buffering.
     """
-    sizes = named_component_sizes(model, dtype_bytes)
+    sizes = named_component_sizes(model, dtype_bytes, layer_dtype_bytes)
     budget = dict(get_max_memory(max_memory))
     largest_layer = max(size for key, size in sizes.items() if key.startswith("layers."))
     # room to stream 2 layers (double buffer) through the device

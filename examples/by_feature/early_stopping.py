@@ -1,0 +1,67 @@
+"""Feature example: coordinated early stopping with set_trigger/check_trigger
+(reference examples/by_feature/early_stopping.py, accelerator.py:2037-2094).
+
+Any process may decide to stop (here: loss under a threshold); the decision
+is all-reduced as a flag tensor so every process breaks on the same step —
+a conditional Python ``break`` alone would desynchronize the collectives.
+
+Run:
+    python examples/by_feature/early_stopping.py --threshold 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Early-stopping example.")
+    parser.add_argument("--num_epochs", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--threshold", type=float, default=0.5)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator()
+    set_seed(42)
+    bert = Bert("bert-tiny")
+    dataset = PairClassificationDataset(vocab_size=bert.config.vocab_size, max_len=64)
+    model, optimizer, loader = accelerator.prepare(
+        bert,
+        optax.adamw(args.lr),
+        accelerator.prepare_data_loader(dataset, batch_size=args.batch_size, shuffle=True, seed=42),
+    )
+    loss_fn = Bert.loss_fn(bert)
+
+    stopped = False
+    for epoch in range(args.num_epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+            if float(loss) < args.threshold:
+                accelerator.set_trigger()  # this process votes to stop
+            if accelerator.check_trigger():  # all-reduced: everyone agrees
+                stopped = True
+                break
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} stopped={stopped}")
+        if stopped:
+            break
+    accelerator.print(f"early stopping {'engaged' if stopped else 'never triggered'}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

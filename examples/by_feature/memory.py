@@ -1,0 +1,67 @@
+"""Feature example: automatic OOM recovery with find_executable_batch_size
+(reference examples/by_feature/memory.py, utils/memory.py:87-158).
+
+The decorated inner function re-runs with a halved batch size whenever the
+step hits an XLA RESOURCE_EXHAUSTED error, so one script works across chip
+generations and model sizes without manual tuning.
+
+Run:
+    python examples/by_feature/memory.py --starting_batch_size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="OOM-retry example.")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--starting_batch_size", type=int, default=256)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def training_function(batch_size):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        # fresh state per attempt: a failed attempt must not leak prepared objects
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        accelerator = Accelerator()
+        set_seed(42)
+        bert = Bert("bert-tiny")
+        dataset = PairClassificationDataset(vocab_size=bert.config.vocab_size, max_len=64)
+        model, optimizer, loader = accelerator.prepare(
+            bert,
+            optax.adamw(args.lr),
+            accelerator.prepare_data_loader(dataset, batch_size=batch_size, shuffle=True, seed=42),
+        )
+        loss_fn = Bert.loss_fn(bert)
+        for epoch in range(args.num_epochs):
+            loader.set_epoch(epoch)
+            for batch in loader:
+                loss = accelerator.backward(loss_fn, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.print(f"trained at batch_size={batch_size}: loss={float(loss):.4f}")
+        return batch_size
+
+    used = training_function()
+    print(f"executable batch size: {used}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Feature example: exact metrics across processes with gather_for_metrics
+(reference examples/by_feature/multi_process_metrics.py).
+
+With even-batch padding, the final batch contains duplicated samples on some
+ranks; ``gather_for_metrics`` drops exactly those duplicates so the metric
+sees every dataset row once — a plain ``gather`` would overcount.
+
+Run:
+    python examples/by_feature/multi_process_metrics.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset, accuracy_f1
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Multi-process metrics example.")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator()
+    set_seed(42)
+    bert = Bert("bert-tiny")
+    dataset = PairClassificationDataset(vocab_size=bert.config.vocab_size, max_len=64)
+    model, optimizer, train_loader = accelerator.prepare(
+        bert,
+        optax.adamw(args.lr),
+        accelerator.prepare_data_loader(dataset, batch_size=args.batch_size, shuffle=True, seed=42),
+    )
+    loss_fn = Bert.loss_fn(bert)
+
+    for epoch in range(args.num_epochs):
+        train_loader.set_epoch(epoch)
+        for batch in train_loader:
+            accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+
+    # eval loader with a deliberately uneven tail: batch 20 does not divide
+    # the 48-row dataset, so the final batch engages the remainder
+    # bookkeeping (and, multi-process, the duplicate-dropping) in
+    # gather_for_metrics
+    eval_loader = accelerator.prepare_data_loader(dataset, batch_size=20)
+    predictions, references = [], []
+    for batch in eval_loader:
+        logits = bert.apply(model.params, batch["input_ids"], batch["attention_mask"], batch["token_type_ids"])
+        preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["labels"]))
+        predictions.append(np.asarray(preds))
+        references.append(np.asarray(refs))
+    predictions = np.concatenate(predictions)
+    references = np.concatenate(references)
+    assert len(predictions) == len(dataset), (len(predictions), len(dataset))
+    metric = accuracy_f1(predictions, references)
+    accelerator.print(f"exact sample count: {len(predictions)} == {len(dataset)}; {metric}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -66,3 +66,24 @@ def test_tracking_example(tmp_path):
     assert lines[0]["_config"]["num_epochs"] == 1
     assert any("train_loss" in l for l in lines)
     assert any("accuracy" in l for l in lines)
+
+
+def test_local_sgd_example():
+    out = run_example("by_feature/local_sgd.py", "--num_epochs", "1")
+    assert re.search(r"final: \{'accuracy'", out)
+
+
+def test_memory_example():
+    out = run_example("by_feature/memory.py", "--starting_batch_size", "16")
+    assert "executable batch size: 16" in out
+
+
+def test_early_stopping_example():
+    out = run_example("by_feature/early_stopping.py", "--num_epochs", "2", "--threshold", "10.0")
+    # threshold 10: triggers immediately on the first step
+    assert "early stopping engaged" in out
+
+
+def test_multi_process_metrics_example():
+    out = run_example("by_feature/multi_process_metrics.py")
+    assert "exact sample count: 48 == 48" in out
