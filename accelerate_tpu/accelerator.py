@@ -396,10 +396,12 @@ class Accelerator:
             if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1:
                 from .parallel.pipeline import make_pipeline_layers_fn
 
+                # default 4 microbatches per stage: GPipe bubble (P-1)/(M+P-1)
+                # drops from ~(P-1)/(2P-1) ≈ 45% at M=P to <20% at M=4P
                 num_micro = (
                     self.model_parallel_plugin.num_microbatches
                     if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
-                    else self.mesh.shape[MESH_AXIS_PIPELINE]
+                    else 4 * self.mesh.shape[MESH_AXIS_PIPELINE]
                 )
                 model.pipeline_fn = make_pipeline_layers_fn(
                     model.config, self.mesh, num_micro, dot_fn=getattr(model, "dot_fn", None)

@@ -107,16 +107,42 @@ def send_to_device(tensor, device=None, non_blocking: bool = False, skip_keys=No
 
     def _send(t):
         target = device
+        state = PartialState()
         if isinstance(target, jax.sharding.NamedSharding):
             # Leaves that can't split evenly over the batch axes (scalars,
-            # odd-length metadata) are replicated instead.
+            # odd-length metadata) are replicated instead. Multi-process, the
+            # input is this HOST's rows, so the global extent is rows × hosts.
             entry = target.spec[0] if len(target.spec) else None
             axes = (entry,) if isinstance(entry, str) else (entry or ())
             split = 1
             for axis in axes:
                 split *= target.mesh.shape[axis]
-            if t.ndim == 0 or (split > 1 and t.shape[0] % split != 0):
+            # every process must hold the same LOCAL row count (the loaders'
+            # even-batch padding guarantees it); values may differ per host
+            global_rows = (t.shape[0] if t.ndim else 0) * state.num_processes
+            if t.ndim == 0 or (split > 1 and global_rows % split != 0):
+                if state.num_processes > 1:
+                    if t.ndim == 0:
+                        # replicated scalar: take rank 0's value so every host
+                        # installs the SAME global array
+                        from jax.experimental import multihost_utils
+
+                        return jax.device_put(
+                            multihost_utils.broadcast_one_to_all(jnp.asarray(t)),
+                            jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec()),
+                        )
+                    raise ValueError(
+                        f"send_to_device: leaf with {t.shape[0]} local rows cannot "
+                        f"shard evenly over {split} batch shards across "
+                        f"{state.num_processes} processes — pad it first "
+                        "(ops.pad_across_processes) or use an even-batch loader."
+                    )
                 target = jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec())
+            elif state.num_processes > 1 and split > 1:
+                # per-host VALUES differ: assemble the global array from
+                # process-local shards (a replicated device_put would install
+                # rank-dependent data)
+                return jax.make_array_from_process_local_data(target, np.asarray(t))
         return jax.device_put(t, target)
 
     if skip_keys:

@@ -42,9 +42,10 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
     decoder stack as a pipeline over the ``pipeline`` mesh axis.
 
     Constraints (v1): the ``sequence`` axis must be 1 (ring attention inside a
-    pipeline stage is a follow-up); global batch must be a multiple of
-    ``num_microbatches``; layer count must divide the pipeline size; cos/sin
-    must be batch-invariant (default integer positions).
+    pipeline stage is a follow-up); layer count must divide the pipeline
+    size; cos/sin must be batch-invariant (default integer positions). The
+    microbatch count adapts downward (with a warning) when it does not
+    divide the batch.
     """
     from ..models.llama import decoder_layer
 
@@ -83,16 +84,25 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         stage = jax.checkpoint(stage)
 
         b = h.shape[0]
-        if b % M != 0:
-            raise ValueError(
-                f"num_microbatches={M} must divide the batch size {b} "
-                "(raise the batch or lower num_microbatches)"
+        # adapt the microbatch count to the actual (static) batch: the default
+        # is 4 per stage for a small bubble, but a tiny batch caps it
+        M_eff = min(M, b)
+        while b % M_eff:
+            M_eff -= 1
+        if M_eff < min(M, b):  # trace-time: fires once per compiled shape
+            from ..logging import get_logger
+
+            get_logger(__name__).warning(
+                f"pipeline: num_microbatches={M} does not divide batch {b}; "
+                f"using {M_eff} — bubble fraction is "
+                f"{(nstages - 1) / (M_eff + nstages - 1):.0%}. Pick a batch "
+                "divisible by the microbatch count to avoid this."
             )
-        mb = h.reshape(M, b // M, *h.shape[1:])
+        mb = h.reshape(M_eff, b // M_eff, *h.shape[1:])
         if mask is None:
-            mask_mb_all = jnp.ones((M, b // M, 1, 1, h.shape[1]), bool)
+            mask_mb_all = jnp.ones((M_eff, b // M_eff, 1, 1, h.shape[1]), bool)
         else:
-            mask_mb_all = mask.reshape(M, b // M, *mask.shape[1:])
+            mask_mb_all = mask.reshape(M_eff, b // M_eff, *mask.shape[1:])
         # the loop makes these pipeline-varying (stage-dependent values); the
         # initial carry must already carry that type for scan to typecheck
         state = to_varying(jnp.zeros_like(mb[0]))
@@ -102,16 +112,16 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
 
         def tick(carry, t):
             state, state_mask, outputs = carry
-            inject = jax.lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), keepdims=False)
+            inject = jax.lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M_eff - 1), keepdims=False)
             inject_mask = jax.lax.dynamic_index_in_dim(
-                mask_mb_all, jnp.clip(t, 0, M - 1), keepdims=False
+                mask_mb_all, jnp.clip(t, 0, M_eff - 1), keepdims=False
             )
             x = jnp.where(idx == 0, inject, state)
             m = jnp.where(idx == 0, inject_mask, state_mask)
             y = stage(x, m)
             out_t = t - (nstages - 1)
             collected = jax.lax.dynamic_update_slice(
-                outputs, y[None].astype(outputs.dtype), (jnp.clip(out_t, 0, M - 1),) + (0,) * y.ndim
+                outputs, y[None].astype(outputs.dtype), (jnp.clip(out_t, 0, M_eff - 1),) + (0,) * y.ndim
             )
             valid = (out_t >= 0) & (idx == nstages - 1)
             outputs = jnp.where(valid, collected, outputs)
@@ -123,7 +133,7 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
                 state, state_mask = y, m
             return (state, state_mask, outputs), None
 
-        ticks = jnp.arange(M + nstages - 1)
+        ticks = jnp.arange(M_eff + nstages - 1)
         (_, _, outputs), _ = jax.lax.scan(tick, (state, state_mask, outputs), ticks)
         # fan the last stage's collected outputs out to every stage; the psum is
         # exact because every other stage contributes zeros. Promote bf16/fp16 to
