@@ -477,13 +477,17 @@ class Accelerator:
                 f"{sorted(loader_kwargs)} would be silently ignored. Pass the "
                 "raw dataset instead to reconfigure it."
             )
-        prepared = prepare_data_loader(
-            loader,
-            device_placement=device_placement if device_placement is not None else self.device_placement,
+        # per-call kwargs override the Accelerator-level loader defaults
+        merged = dict(
             split_batches=self.split_batches,
             even_batches=self.even_batches,
             dispatch_batches=self.dispatch_batches,
-            **loader_kwargs,
+        )
+        merged.update(loader_kwargs)
+        prepared = prepare_data_loader(
+            loader,
+            device_placement=device_placement if device_placement is not None else self.device_placement,
+            **merged,
         )
         self._dataloaders.append(prepared)
         return prepared

@@ -32,6 +32,11 @@ class TransformerConfig:
     type_vocab_size: int = 2
     num_labels: int = 2
     dropout_rate: float = 0.0
+    # mixture-of-experts (decoder): num_experts > 1 swaps the gated MLP for a
+    # top-k routed expert MLP sharded over the `expert` mesh axis
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def kv_heads(self) -> int:
@@ -71,6 +76,12 @@ _REGISTRY: dict[str, TransformerConfig] = {
         arch="llama", vocab_size=32000, hidden_size=8192, intermediate_size=28672,
         num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=4096,
     ),
+    # moe variant of the decoder family (expert-parallel MLP)
+    "llama-moe-tiny": TransformerConfig(
+        arch="llama", vocab_size=1024, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+        num_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+    ),
     # bert family (encoder) — nlp_example parity (BERT-base MRPC)
     "bert-tiny": TransformerConfig(
         arch="bert", vocab_size=1024, hidden_size=128, intermediate_size=512,
@@ -106,11 +117,15 @@ def param_count(config: TransformerConfig) -> int:
     h, i, v = config.hidden_size, config.intermediate_size, config.vocab_size
     d, nh, nkv = config.dim_per_head, config.num_heads, config.kv_heads
     if config.arch == "llama":
+        if config.num_experts > 1:
+            mlp = h * config.num_experts + config.num_experts * 2 * h * i  # router + experts
+        else:
+            mlp = 3 * h * i  # gate, up, down
         per_layer = (
             h * (nh * d)          # q
             + 2 * h * (nkv * d)   # k, v
             + (nh * d) * h        # o
-            + 3 * h * i           # gate, up, down
+            + mlp
             + 2 * h               # two rmsnorms
         )
         total = v * h + config.num_layers * per_layer + h  # embed + layers + final norm

@@ -23,7 +23,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_FSDP, MESH_AXIS_SEQUENCE, MESH_AXIS_TENSOR
+from ..utils.constants import (
+    MESH_AXIS_DATA,
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
 from .attention import apply_rotary, dense_init, dot_product_attention, dropout, rotary_embedding
 from .config import TransformerConfig, get_config
 
@@ -59,10 +65,12 @@ def decoder_layer(
     attention_fn=None,  # e.g. ring attention for sequence-sharded activations
     kv_mask=None,  # raw [B, S] validity mask for attention_fn implementations
     dot_fn=None,  # e.g. ops.fp8.fp8_dot for fp8 projection compute
+    return_aux: bool = False,  # also return the MoE load-balance loss term
 ):
     """The one llama decoder layer used by every execution path (training
     scan, KV-cache decode, streamed big-model inference). Returns
-    (h, updated_cache_or_None)."""
+    (h, updated_cache_or_None), plus the per-layer MoE aux loss (0 for dense
+    layers) when ``return_aux``."""
     from .attention import dropout, resolve_dot  # local import to avoid cycle at module load
 
     dot = resolve_dot(dot_fn)
@@ -89,11 +97,24 @@ def decoder_layer(
         attn_out = dropout(attn_out, dropout_rate, dropout_rngs[0])
     h = h + attn_out
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(dot(x, lp["w_gate"])) * dot(x, lp["w_up"])
-    mlp_out = dot(gated, lp["w_down"])
+    aux = jnp.zeros((), jnp.float32)
+    if "router" in lp:
+        # MoE decoder (config.num_experts > 1): top-k routed expert MLP over
+        # the `expert` mesh axis; Llama.apply sums the per-layer balance loss
+        from .moe import routed_mlp
+
+        mlp_out, aux = routed_mlp(
+            x, lp["router"], lp["moe_up"], lp["moe_down"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+        )
+    else:
+        gated = jax.nn.silu(dot(x, lp["w_gate"])) * dot(x, lp["w_up"])
+        mlp_out = dot(gated, lp["w_down"])
     if dropout_rngs[1] is not None:
         mlp_out = dropout(mlp_out, dropout_rate, dropout_rngs[1])
     h = h + mlp_out
+    if return_aux:
+        return h, new_cache, aux
     return h, new_cache
 
 
@@ -133,6 +154,8 @@ class Llama:
         d, nh, nkv, L = cfg.dim_per_head, cfg.num_heads, cfg.kv_heads, cfg.num_layers
         keys = iter(jax.random.split(rng, 16))
         dense = dense_init
+        # key consumption order is part of the format: embed → attention →
+        # mlp → lm_head, so dense-model seeds reproduce across versions
         params = {
             "embed_tokens": jax.random.normal(next(keys), (v, h), jnp.float32) * 0.02,
             "layers": {
@@ -142,12 +165,18 @@ class Llama:
                 "wv": dense(next(keys), (L, h, nkv * d), h),
                 "wo": dense(next(keys), (L, nh * d, h), nh * d),
                 "mlp_norm": jnp.ones((L, h), jnp.float32),
-                "w_gate": dense(next(keys), (L, h, i), h),
-                "w_up": dense(next(keys), (L, h, i), h),
-                "w_down": dense(next(keys), (L, i, h), i),
             },
             "final_norm": jnp.ones((h,), jnp.float32),
         }
+        if cfg.num_experts > 1:
+            E = cfg.num_experts
+            params["layers"]["router"] = dense(next(keys), (L, h, E), h)
+            params["layers"]["moe_up"] = dense(next(keys), (L, E, h, i), h)
+            params["layers"]["moe_down"] = dense(next(keys), (L, E, i, h), i)
+        else:
+            params["layers"]["w_gate"] = dense(next(keys), (L, h, i), h)
+            params["layers"]["w_up"] = dense(next(keys), (L, h, i), h)
+            params["layers"]["w_down"] = dense(next(keys), (L, i, h), i)
         if not cfg.tie_embeddings:
             params["lm_head"] = dense(next(keys), (h, v), h)
         return params
@@ -168,6 +197,10 @@ class Llama:
             (r"layers/wo", (p, t, None)),          # row-parallel
             (r"layers/(w_gate|w_up)", (p, None, t)),
             (r"layers/w_down", (p, t, None)),
+            # MoE: experts over the expert axis, TP inside each expert
+            (r"layers/router", (p, None, None)),
+            (r"layers/moe_up", (p, MESH_AXIS_EXPERT, None, t)),
+            (r"layers/moe_down", (p, MESH_AXIS_EXPERT, t, None)),
             (r"layers/(attn_norm|mlp_norm)", (p, None)),
             (r"final_norm", (None,)),
             (r"lm_head", (None, t)),
@@ -182,9 +215,11 @@ class Llama:
         attention_mask: Optional[jax.Array] = None,  # [B, S] 1=real
         positions: Optional[jax.Array] = None,
         dropout_rng: Optional[jax.Array] = None,
+        return_aux: bool = False,  # also return the summed MoE balance loss
     ) -> jax.Array:
         """Logits [B, S, V]. Pass ``dropout_rng`` to enable config.dropout_rate
-        residual dropout during training."""
+        residual dropout during training; ``return_aux`` adds the summed MoE
+        load-balance loss as a second output (0 for dense configs)."""
         cfg = self.config
         b, s = input_ids.shape
         d, nh, nkv = cfg.dim_per_head, cfg.num_heads, cfg.kv_heads
@@ -206,18 +241,23 @@ class Llama:
         def layer(h, xs):
             lp = xs[0] if use_dropout else xs
             rngs = tuple(xs[1]) if use_dropout else (None, None)
-            h, _ = decoder_layer(
+            h, _, aux = decoder_layer(
                 cfg, h, lp, cos, sin, mask, causal=True,
                 dropout_rngs=rngs, dropout_rate=cfg.dropout_rate,
                 attention_fn=self.attention_fn, kv_mask=attention_mask,
-                dot_fn=self.dot_fn,
+                dot_fn=self.dot_fn, return_aux=True,
             )
             h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
-            return h, None
+            return h, aux
 
+        total_aux = jnp.zeros((), jnp.float32)
         if self.pipeline_fn is not None:
             if use_dropout:
                 raise NotImplementedError("dropout inside the pipeline schedule is not supported yet")
+            if return_aux and cfg.num_experts > 1:
+                raise NotImplementedError(
+                    "the MoE balance loss is not threaded through the pipeline schedule yet"
+                )
             h = self.pipeline_fn(params["layers"], h, cos, sin, mask)
         else:
             xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
@@ -226,28 +266,39 @@ class Llama:
                 if self.remat_layers
                 else layer
             )
-            h, _ = jax.lax.scan(body, h, xs)
+            h, aux_per_layer = jax.lax.scan(body, h, xs)
+            total_aux = aux_per_layer.sum()
         h = rms_norm(h, params["final_norm"], cfg.norm_eps)
         head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
         logits = h @ head.astype(h.dtype)
+        if return_aux:
+            return logits, total_aux
         return logits
 
     # -- loss helper -------------------------------------------------------
 
     @staticmethod
     def loss_fn(model: "Llama"):
-        """Next-token cross-entropy over a batch {input_ids, [attention_mask]}."""
+        """Next-token cross-entropy over a batch {input_ids, [attention_mask]};
+        MoE configs add the router load-balance loss."""
+        moe = model.config.num_experts > 1
 
         def fn(params, batch):
             input_ids = batch["input_ids"]
-            logits = model.apply(params, input_ids, batch.get("attention_mask"))
+            if moe:
+                logits, aux = model.apply(
+                    params, input_ids, batch.get("attention_mask"), return_aux=True
+                )
+            else:
+                logits = model.apply(params, input_ids, batch.get("attention_mask"))
+                aux = 0.0
             targets = input_ids[:, 1:]
             logits = logits[:, :-1].astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
             if "attention_mask" in batch:
                 w = batch["attention_mask"][:, 1:].astype(jnp.float32)
-                return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
-            return nll.mean()
+                return (nll * w).sum() / jnp.maximum(w.sum(), 1.0) + aux
+            return nll.mean() + aux
 
         return fn

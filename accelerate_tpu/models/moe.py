@@ -85,50 +85,76 @@ class MoEBlock:
         Returns ``y`` (same shape as ``x``) or ``(y, aux_loss)`` with the
         GShard load-balance auxiliary loss.
         """
-        b, s, h = x.shape
-        e, k = self.num_experts, self.top_k
-        t = b * s
-        c = self.capacity(t)
-        tokens = x.reshape(t, h)
+        y, aux = routed_mlp(
+            x,
+            params["router"],
+            params["w_up"],
+            params["w_down"],
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            aux_loss_weight=self.aux_loss_weight,
+        )
+        return (y, aux) if return_aux else y
 
-        router_logits = (tokens @ params["router"]).astype(jnp.float32)  # [T, E]
-        probs = jax.nn.softmax(router_logits, axis=-1)
 
-        # top-k selection; gates renormalized over the selected experts
-        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
-        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+def routed_mlp(
+    x: jax.Array,  # [B, S, H]
+    router: jax.Array,  # [H, E]
+    w_up: jax.Array,  # [E, H, F]
+    w_down: jax.Array,  # [E, F, H]
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    aux_loss_weight: float = 0.01,
+) -> tuple[jax.Array, jax.Array]:
+    """GShard dense-dispatch expert MLP — the core shared by ``MoEBlock`` and
+    the llama-family MoE layers. Returns ``(y, aux_load_balance_loss)``."""
+    b, s, h = x.shape
+    e = router.shape[-1]
+    k = top_k
+    if k > e:
+        raise ValueError(f"top_k={k} > num_experts={e}")
+    t = b * s
+    c = max(int(math.ceil(k * t / e * capacity_factor)), 1)
+    tokens = x.reshape(t, h)
 
-        # capacity assignment: position of each (token, choice) in its
-        # expert's queue, computed with one-hot cumsums (static shapes)
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, k, E]
-        # priority: choice 0 of every token beats choice 1 of any token
-        flat_choice = onehot.transpose(1, 0, 2).reshape(k * t, e)  # [k*T, E]
-        position = (jnp.cumsum(flat_choice, axis=0) - 1.0) * flat_choice  # [k*T, E]
-        within_cap = (position < c) & (flat_choice > 0)
-        position = position.reshape(k, t, e).transpose(1, 0, 2)  # [T, k, E]
-        within_cap = within_cap.reshape(k, t, e).transpose(1, 0, 2)
+    # routing stays fp32 (GShard/Switch convention): near-tied logits in bf16
+    # flip top-k selections
+    router_logits = tokens.astype(jnp.float32) @ router.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
 
-        cap_onehot = jax.nn.one_hot(position.astype(jnp.int32), c, dtype=jnp.float32)  # [T,k,E,C]
-        cap_onehot = cap_onehot * within_cap[..., None]
-        dispatch = (onehot[..., None] * cap_onehot).sum(axis=1)  # [T, E, C]
-        combine = (gate_vals[..., None, None] * onehot[..., None] * cap_onehot).sum(axis=1)
+    # top-k selection; gates renormalized over the selected experts
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
 
-        # expert compute: dispatch/combine einsums become all-to-alls under
-        # the expert-axis sharding of the [E, ...] tensors
-        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
-        expert_in = _constrain_expert(expert_in)
-        h1 = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, params["w_up"].astype(x.dtype)))
-        expert_out = jnp.einsum("ecf,efh->ech", h1, params["w_down"].astype(x.dtype))
-        expert_out = _constrain_expert(expert_out)
-        y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out).reshape(b, s, h)
+    # capacity assignment: position of each (token, choice) in its
+    # expert's queue, computed with one-hot cumsums (static shapes)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, k, E]
+    # priority: choice 0 of every token beats choice 1 of any token
+    flat_choice = onehot.transpose(1, 0, 2).reshape(k * t, e)  # [k*T, E]
+    position = (jnp.cumsum(flat_choice, axis=0) - 1.0) * flat_choice  # [k*T, E]
+    within_cap = (position < c) & (flat_choice > 0)
+    position = position.reshape(k, t, e).transpose(1, 0, 2)  # [T, k, E]
+    within_cap = within_cap.reshape(k, t, e).transpose(1, 0, 2)
 
-        if not return_aux:
-            return y
-        # load-balance loss (GShard eq. 4): E * Σ_e mean_prob_e * dispatch_frac_e
-        dispatch_frac = (onehot[:, 0].sum(0) / t).astype(jnp.float32)  # first-choice counts
-        mean_prob = probs.mean(0)
-        aux = self.aux_loss_weight * e * jnp.sum(dispatch_frac * mean_prob)
-        return y, aux
+    cap_onehot = jax.nn.one_hot(position.astype(jnp.int32), c, dtype=jnp.float32)  # [T,k,E,C]
+    cap_onehot = cap_onehot * within_cap[..., None]
+    dispatch = (onehot[..., None] * cap_onehot).sum(axis=1)  # [T, E, C]
+    combine = (gate_vals[..., None, None] * onehot[..., None] * cap_onehot).sum(axis=1)
+
+    # expert compute: dispatch/combine einsums become all-to-alls under
+    # the expert-axis sharding of the [E, ...] tensors
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
+    expert_in = _constrain_expert(expert_in)
+    h1 = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w_up.astype(x.dtype)))
+    expert_out = jnp.einsum("ecf,efh->ech", h1, w_down.astype(x.dtype))
+    expert_out = _constrain_expert(expert_out)
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out).reshape(b, s, h)
+
+    # load-balance loss (GShard eq. 4): E * Σ_e mean_prob_e * dispatch_frac_e
+    dispatch_frac = (onehot[:, 0].sum(0) / t).astype(jnp.float32)  # first-choice counts
+    mean_prob = probs.mean(0)
+    aux = aux_loss_weight * e * jnp.sum(dispatch_frac * mean_prob)
+    return y, aux
 
 
 def _constrain_expert(value: jax.Array) -> jax.Array:

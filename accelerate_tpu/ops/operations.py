@@ -122,20 +122,16 @@ def send_to_device(tensor, device=None, non_blocking: bool = False, skip_keys=No
             global_rows = (t.shape[0] if t.ndim else 0) * state.num_processes
             if t.ndim == 0 or (split > 1 and global_rows % split != 0):
                 if state.num_processes > 1:
-                    if t.ndim == 0:
-                        # replicated scalar: take rank 0's value so every host
-                        # installs the SAME global array
-                        from jax.experimental import multihost_utils
+                    # replicated fallback (scalars, odd-length metadata): take
+                    # rank 0's value so every host installs the SAME global
+                    # array. Per-host ROW data that lands here is a bug on the
+                    # caller's side — pad it (ops.pad_across_processes) or use
+                    # an even-batch loader.
+                    from jax.experimental import multihost_utils
 
-                        return jax.device_put(
-                            multihost_utils.broadcast_one_to_all(jnp.asarray(t)),
-                            jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec()),
-                        )
-                    raise ValueError(
-                        f"send_to_device: leaf with {t.shape[0]} local rows cannot "
-                        f"shard evenly over {split} batch shards across "
-                        f"{state.num_processes} processes — pad it first "
-                        "(ops.pad_across_processes) or use an even-batch loader."
+                    return jax.device_put(
+                        multihost_utils.broadcast_one_to_all(jnp.asarray(t)),
+                        jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec()),
                     )
                 target = jax.sharding.NamedSharding(target.mesh, jax.sharding.PartitionSpec())
             elif state.num_processes > 1 and split > 1:

@@ -89,14 +89,14 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         M_eff = min(M, b)
         while b % M_eff:
             M_eff -= 1
-        if M_eff < min(M, b):  # trace-time: fires once per compiled shape
+        if M_eff < M:  # trace-time: fires once per compiled shape
             from ..logging import get_logger
 
             get_logger(__name__).warning(
-                f"pipeline: num_microbatches={M} does not divide batch {b}; "
-                f"using {M_eff} — bubble fraction is "
-                f"{(nstages - 1) / (M_eff + nstages - 1):.0%}. Pick a batch "
-                "divisible by the microbatch count to avoid this."
+                f"pipeline: num_microbatches={M} cut to {M_eff} by batch {b} — "
+                f"bubble fraction is {(nstages - 1) / (M_eff + nstages - 1):.0%}. "
+                "Raise the batch (or pick one divisible by the microbatch "
+                "count) to shrink it."
             )
         mb = h.reshape(M_eff, b // M_eff, *h.shape[1:])
         if mask is None:

@@ -97,6 +97,38 @@ def main():
     assert all(v == views[0] for v in views), views
     assert abs(a - 2.0) < 0.5 and abs(b - 1.0) < 0.5, (a, b)
 
+    # DataLoaderDispatcher: process 0 owns the stream; every process must see
+    # its exact slice, in order, across the uneven tail
+    def stream():
+        for i in range(22):  # not a multiple of the global batch
+            yield {"x": np.float32(i)}
+
+    dispatcher = accelerator.prepare_data_loader(stream(), batch_size=4, dispatch_batches=True)
+    rows = []
+    for batch in dispatcher:
+        rows.append(np.asarray(ops.gather(batch["x"])))
+    flat = np.concatenate([r.ravel() for r in rows])
+    # every real row appears; the wrap-around fill may duplicate early rows
+    assert set(range(22)) <= set(flat.astype(int).tolist()), sorted(set(flat.astype(int)))
+
+    # gather_for_metrics drops the duplicated tail exactly
+    n = state.num_processes * 8 + 3
+
+    class DS2:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    loader2 = accelerator.prepare_data_loader(DS2(), batch_size=8)
+    seen = []
+    for batch in loader2:
+        seen.append(np.asarray(accelerator.gather_for_metrics(batch["x"])))
+    flat2 = np.concatenate(seen)
+    assert len(flat2) == n, (len(flat2), n)
+    assert set(flat2.astype(int).tolist()) == set(range(n))
+
     state.wait_for_everyone()
     state.print(json.dumps({"multiprocess_ok": True, "processes": state.num_processes, "devices": state.num_devices}))
 

@@ -98,6 +98,11 @@ def import_hf_llama(
     Raises KeyError on missing tensors and ValueError on shape mismatches so a
     wrong-config import fails loudly rather than silently truncating.
     """
+    if getattr(config, "num_experts", 1) > 1:
+        raise NotImplementedError(
+            "HF llama checkpoint interop covers the dense family; MoE variants "
+            "use the native checkpoint format (save_model_weights)."
+        )
     L = config.num_layers
     h = config.hidden_size
     consumed = set()

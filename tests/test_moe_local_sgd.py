@@ -200,3 +200,64 @@ def test_local_sgd_disabled_is_synchronous():
         params = optax.apply_updates(params, updates)
     np.testing.assert_allclose(float(local["a"]), float(params["a"]), rtol=1e-5)
     np.testing.assert_allclose(float(local["b"]), float(params["b"]), rtol=1e-5)
+
+
+# -- MoE inside the llama family ---------------------------------------------
+
+
+def test_llama_moe_trains_and_shards_experts():
+    """config.num_experts > 1 swaps the MLP for routed experts; the model
+    trains under the Accelerator with experts on the expert axis."""
+    import optax
+
+    from accelerate_tpu.models import Llama
+    from accelerate_tpu.models.config import param_count
+
+    _reset()
+    acc = Accelerator(parallelism=ParallelismConfig(expert=2, data=4))
+    model = Llama("llama-moe-tiny")
+    prepared = acc.prepare(model)
+    assert "router" in prepared.params["layers"]
+    spec = prepared.params_shardings["layers"]["moe_up"].spec
+    assert spec[1] == "expert"
+    # exact param count accounting includes the experts
+    total = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(prepared.params))
+    assert total == param_count(model.config)
+
+    opt = acc.prepare_optimizer(optax.adam(1e-3))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (8, 16)), jnp.int32)
+    loss_fn = Llama.loss_fn(model)
+    losses = []
+    for _ in range(8):
+        losses.append(float(acc.backward(loss_fn, {"input_ids": ids})))
+        opt.step()
+        opt.zero_grad()
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_llama_moe_generate():
+    from accelerate_tpu.models import Llama
+    from accelerate_tpu.models.generation import generate
+
+    _reset()
+    model = Llama("llama-moe-tiny")
+    params = model.init(jax.random.key(0))
+    out = generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), max_new_tokens=4)
+    assert out.shape == (1, 7)
+
+
+def test_llama_moe_loss_includes_balance_term():
+    from accelerate_tpu.models import Llama
+
+    _reset()
+    model = Llama("llama-moe-tiny")
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 1024, (2, 16)), jnp.int32)
+    logits, aux = model.apply(params, ids, return_aux=True)
+    assert float(aux) > 0
+    total = float(Llama.loss_fn(model)(params, {"input_ids": ids}))
+    # the training loss is CE + aux, not bare CE
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    ce = float(-jnp.take_along_axis(logp, ids[:, 1:][..., None], axis=-1).mean())
+    np.testing.assert_allclose(total, ce + float(aux), rtol=1e-5)
