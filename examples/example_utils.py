@@ -83,3 +83,24 @@ def accuracy_f1(predictions: np.ndarray, references: np.ndarray) -> dict[str, fl
     fn = float(((predictions == 0) & (references == 1)).sum())
     f1 = 2 * tp / (2 * tp + fp + fn) if (2 * tp + fp + fn) else 0.0
     return {"accuracy": round(accuracy, 4), "f1": round(f1, 4)}
+
+
+class Subset:
+    """Index-view over a map-style dataset (shared by the example scripts)."""
+
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = indices
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        return self.dataset[int(self.indices[i])]
+
+
+def train_eval_split(dataset, eval_fraction: float = 0.25, seed: int = 0):
+    """Deterministic shuffled train/eval split used by every example."""
+    n_eval = max(int(len(dataset) * eval_fraction), 1)
+    indices = np.random.default_rng(seed).permutation(len(dataset))
+    return Subset(dataset, indices[n_eval:]), Subset(dataset, indices[:n_eval])

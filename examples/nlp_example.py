@@ -25,7 +25,7 @@ import optax
 import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from example_utils import PairClassificationDataset, accuracy_f1
+from example_utils import PairClassificationDataset, accuracy_f1, train_eval_split
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models import Bert
@@ -37,24 +37,12 @@ EVAL_BATCH_SIZE = 16
 def get_dataloaders(accelerator: Accelerator, batch_size: int, max_len: int, vocab_size: int):
     """Train/eval loaders over the bundled dataset (deterministic split)."""
     dataset = PairClassificationDataset(vocab_size=vocab_size, max_len=max_len)
-    n_eval = max(len(dataset) // 4, 1)
-    indices = np.random.default_rng(0).permutation(len(dataset))
-
-    class Subset:
-        def __init__(self, idx):
-            self.idx = idx
-
-        def __len__(self):
-            return len(self.idx)
-
-        def __getitem__(self, i):
-            return dataset[int(self.idx[i])]
-
+    train_set, eval_set = train_eval_split(dataset)
     train_loader = accelerator.prepare_data_loader(
-        Subset(indices[n_eval:]), batch_size=batch_size, shuffle=True, seed=42
+        train_set, batch_size=batch_size, shuffle=True, seed=42
     )
     eval_loader = accelerator.prepare_data_loader(
-        Subset(indices[:n_eval]), batch_size=EVAL_BATCH_SIZE, shuffle=False
+        eval_set, batch_size=EVAL_BATCH_SIZE, shuffle=False
     )
     return train_loader, eval_loader
 

@@ -87,3 +87,27 @@ def test_early_stopping_example():
 def test_multi_process_metrics_example():
     out = run_example("by_feature/multi_process_metrics.py")
     assert "exact sample count: 48 == 48" in out
+
+
+def test_complete_nlp_example(tmp_path):
+    out = run_example(
+        "complete_nlp_example.py", "--num_epochs", "1", "--with_tracking",
+        "--checkpointing_steps", "epoch", "--output_dir", str(tmp_path),
+    )
+    assert re.search(r"epoch 0: \{'accuracy'", out)
+    assert os.path.exists(tmp_path / "epoch_0" / "model_0.safetensors")
+    assert os.path.exists(tmp_path / "complete_nlp_example" / "metrics.jsonl")
+    # resume from the epoch checkpoint
+    out = run_example(
+        "complete_nlp_example.py", "--num_epochs", "2",
+        "--resume_from_checkpoint", str(tmp_path / "epoch_0"), "--output_dir", str(tmp_path),
+    )
+    assert "resumed at epoch 1" in out
+    assert re.search(r"epoch 1: \{'accuracy'", out)
+
+
+def test_cv_example():
+    out = run_example("cv_example.py", "--num_epochs", "4")
+    match = re.search(r"epoch 3: loss=[\d.]+ accuracy=([\d.]+)", out)
+    assert match, out
+    assert float(match.group(1)) > 0.5  # a convnet must beat 3-way chance solidly
