@@ -1,0 +1,69 @@
+"""Feature example: automatic gradient accumulation (reference
+examples/by_feature/automatic_gradient_accumulation.py) — keep the EFFECTIVE
+batch size fixed while find_executable_batch_size shrinks the per-step batch
+to whatever fits, raising the accumulation count to compensate.
+
+Run:
+    python examples/by_feature/automatic_gradient_accumulation.py \
+        --observed_batch_size 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset, reset_accelerator_state
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Automatic gradient accumulation example.")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument(
+        "--observed_batch_size", type=int, default=64,
+        help="The effective batch size training should behave as if it used",
+    )
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    @find_executable_batch_size(starting_batch_size=args.observed_batch_size)
+    def training_function(batch_size):
+        reset_accelerator_state()  # a failed attempt must not leak prepared objects
+        accumulation = max(args.observed_batch_size // batch_size, 1)
+        accelerator = Accelerator(gradient_accumulation_steps=accumulation)
+        set_seed(42)
+        bert = Bert("bert-tiny")
+        dataset = PairClassificationDataset(vocab_size=bert.config.vocab_size, max_len=64)
+        model, optimizer, loader = accelerator.prepare(
+            bert,
+            optax.adamw(args.lr),
+            accelerator.prepare_data_loader(dataset, batch_size=batch_size, shuffle=True, seed=42),
+        )
+        loss_fn = Bert.loss_fn(bert)
+        for epoch in range(args.num_epochs):
+            loader.set_epoch(epoch)
+            for batch in loader:
+                with accelerator.accumulate(model):
+                    loss = accelerator.backward(loss_fn, batch)
+                    optimizer.step()
+                    optimizer.zero_grad()
+        accelerator.print(
+            f"trained at batch_size={batch_size} x accumulation={accumulation} "
+            f"(effective {batch_size * accumulation}); loss={float(loss):.4f}"
+        )
+        return batch_size, accumulation
+
+    used, accum = training_function()
+    print(f"final: batch_size={used} accumulation={accum}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Feature example: k-fold cross validation (reference
+examples/by_feature/cross_validation.py) — train k models on k splits,
+evaluate each on its held-out fold with exact distributed metrics, and report
+the mean.
+
+Run:
+    python examples/by_feature/cross_validation.py --num_folds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset, Subset, accuracy_f1
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="K-fold cross-validation example.")
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator()
+    set_seed(42)
+    bert_cfg = Bert("bert-tiny").config
+    dataset = PairClassificationDataset(vocab_size=bert_cfg.vocab_size, max_len=64)
+    indices = np.random.default_rng(0).permutation(len(dataset))
+    folds = np.array_split(indices, args.num_folds)
+
+    scores = []
+    for fold in range(args.num_folds):
+        eval_idx = folds[fold]
+        train_idx = np.concatenate([f for j, f in enumerate(folds) if j != fold])
+        bert = Bert("bert-tiny")  # fresh model per fold
+        model = accelerator.prepare_model(bert)
+        optimizer = accelerator.prepare_optimizer(optax.adamw(args.lr))
+        train_loader = accelerator.prepare_data_loader(
+            Subset(dataset, train_idx), batch_size=args.batch_size, shuffle=True, seed=42 + fold
+        )
+        eval_loader = accelerator.prepare_data_loader(Subset(dataset, eval_idx), batch_size=16)
+        loss_fn = Bert.loss_fn(bert)
+
+        for epoch in range(args.num_epochs):
+            train_loader.set_epoch(epoch)
+            for batch in train_loader:
+                accelerator.backward(loss_fn, batch, model=model)
+                optimizer.step()
+                optimizer.zero_grad()
+
+        predictions, references = [], []
+        for batch in eval_loader:
+            logits = bert.apply(model.params, batch["input_ids"], batch["attention_mask"], batch["token_type_ids"])
+            preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["labels"]))
+            predictions.append(np.asarray(preds))
+            references.append(np.asarray(refs))
+        metric = accuracy_f1(np.concatenate(predictions), np.concatenate(references))
+        scores.append(metric["accuracy"])
+        accelerator.print(f"fold {fold}: {metric}")
+        # release this fold's params/optimizer state before the next fold
+        accelerator.free_memory()
+
+    accelerator.print(f"mean accuracy over {args.num_folds} folds: {float(np.mean(scores)):.4f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ import sys
 import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from example_utils import PairClassificationDataset
+from example_utils import PairClassificationDataset, reset_accelerator_state
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models import Bert
@@ -34,12 +34,7 @@ def main(argv=None):
 
     @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
     def training_function(batch_size):
-        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
-
-        # fresh state per attempt: a failed attempt must not leak prepared objects
-        AcceleratorState._reset_state()
-        GradientState._reset_state()
-        PartialState._reset_state()
+        reset_accelerator_state()  # a failed attempt must not leak prepared objects
         accelerator = Accelerator()
         set_seed(42)
         bert = Bert("bert-tiny")

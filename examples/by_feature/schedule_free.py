@@ -1,0 +1,74 @@
+"""Feature example: Schedule-Free optimization (reference
+examples/by_feature/schedule_free.py) — optax's schedule-free AdamW needs no
+LR schedule at all; evaluation uses the averaged iterate.
+
+Run:
+    python examples/by_feature/schedule_free.py --num_epochs 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import PairClassificationDataset, accuracy_f1, train_eval_split
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Bert
+from accelerate_tpu.utils import set_seed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Schedule-free optimizer example.")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--warmup_steps", type=int, default=2)
+    args = parser.parse_args(argv)
+
+    accelerator = Accelerator()
+    set_seed(42)
+    bert = Bert("bert-tiny")
+    dataset = PairClassificationDataset(vocab_size=bert.config.vocab_size, max_len=64)
+    train_set, eval_set = train_eval_split(dataset)
+
+    tx = optax.contrib.schedule_free_adamw(learning_rate=args.lr, warmup_steps=args.warmup_steps)
+    model, optimizer, train_loader = accelerator.prepare(
+        bert,
+        tx,
+        accelerator.prepare_data_loader(train_set, batch_size=args.batch_size, shuffle=True, seed=42),
+    )
+    eval_loader = accelerator.prepare_data_loader(eval_set, batch_size=16)
+    loss_fn = Bert.loss_fn(bert)
+
+    for epoch in range(args.num_epochs):
+        train_loader.set_epoch(epoch)
+        for batch in train_loader:
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        # schedule-free evaluates at the AVERAGED iterate, not the raw params
+        eval_params = optax.contrib.schedule_free_eval_params(optimizer.opt_state, model.params)
+        predictions, references = [], []
+        for batch in eval_loader:
+            logits = bert.apply(eval_params, batch["input_ids"], batch["attention_mask"], batch["token_type_ids"])
+            preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["labels"]))
+            predictions.append(np.asarray(preds))
+            references.append(np.asarray(refs))
+        metric = accuracy_f1(np.concatenate(predictions), np.concatenate(references))
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} {metric}")
+
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

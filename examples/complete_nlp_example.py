@@ -109,7 +109,10 @@ def main(argv=None):
                 optimizer.zero_grad()
             progress.step += 1
             if args.with_tracking:
-                accelerator.log({"train_loss": float(loss), "lr": scheduler.get_last_lr()[0]}, step=progress.step)
+                accelerator.log(
+                    {"train_loss": float(loss), "lr": float(schedule(optimizer.step_count))},
+                    step=progress.step,
+                )
             if args.checkpointing_steps and args.checkpointing_steps != "epoch":
                 if progress.step % int(args.checkpointing_steps) == 0:
                     accelerator.save_state(os.path.join(args.output_dir, f"step_{progress.step}"))

@@ -91,12 +91,18 @@ def main(argv=None):
 
     accelerator = Accelerator(mixed_precision=args.mixed_precision)
     set_seed(42)
-    dataset = ShapesDataset()
+    import os, sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from example_utils import train_eval_split
+
+    train_set, eval_set = train_eval_split(ShapesDataset())
     model, optimizer, loader = accelerator.prepare(
         SmallConvNet(),
         optax.adam(args.lr),
-        accelerator.prepare_data_loader(dataset, batch_size=args.batch_size, shuffle=True, seed=42),
+        accelerator.prepare_data_loader(train_set, batch_size=args.batch_size, shuffle=True, seed=42),
     )
+    eval_loader = accelerator.prepare_data_loader(eval_set, batch_size=args.batch_size)
 
     for epoch in range(args.num_epochs):
         loader.set_epoch(epoch)
@@ -106,7 +112,7 @@ def main(argv=None):
             optimizer.zero_grad()
 
         correct, total = 0, 0
-        for batch in loader:
+        for batch in eval_loader:
             logits = SmallConvNet.apply(model.params, batch["image"])
             preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["label"]))
             correct += int((np.asarray(preds) == np.asarray(refs)).sum())

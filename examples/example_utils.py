@@ -104,3 +104,13 @@ def train_eval_split(dataset, eval_fraction: float = 0.25, seed: int = 0):
     n_eval = max(int(len(dataset) * eval_fraction), 1)
     indices = np.random.default_rng(seed).permutation(len(dataset))
     return Subset(dataset, indices[n_eval:]), Subset(dataset, indices[:n_eval])
+
+
+def reset_accelerator_state():
+    """Drop the topology singletons so a fresh Accelerator can be built
+    (used by the OOM-retry examples, which rebuild everything per attempt)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

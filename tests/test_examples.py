@@ -111,3 +111,19 @@ def test_cv_example():
     match = re.search(r"epoch 3: loss=[\d.]+ accuracy=([\d.]+)", out)
     assert match, out
     assert float(match.group(1)) > 0.5  # a convnet must beat 3-way chance solidly
+
+
+def test_schedule_free_example():
+    out = run_example("by_feature/schedule_free.py", "--num_epochs", "1")
+    assert re.search(r"epoch 0: loss=[\d.]+ \{'accuracy'", out)
+
+
+def test_automatic_gradient_accumulation_example():
+    out = run_example("by_feature/automatic_gradient_accumulation.py", "--observed_batch_size", "32")
+    assert re.search(r"final: batch_size=\d+ accumulation=\d+", out)
+
+
+def test_cross_validation_example():
+    out = run_example("by_feature/cross_validation.py", "--num_folds", "2")
+    assert "fold 1:" in out
+    assert re.search(r"mean accuracy over 2 folds: [\d.]+", out)
