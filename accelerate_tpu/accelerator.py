@@ -47,6 +47,8 @@ from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
     CompilationConfig,
+    DistributedInitKwargs,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     KwargsHandler,
@@ -165,9 +167,29 @@ class Accelerator:
 
         # -- kwargs handlers (reference accelerator.py:338-372)
         self.loss_scale_kwargs: Optional[LossScaleKwargs] = None
+        self.fp8_recipe: Optional[FP8RecipeKwargs] = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, LossScaleKwargs):
                 self.loss_scale_kwargs = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe = handler
+            elif isinstance(handler, DistributedInitKwargs):
+                # consumed by PartialState._bootstrap_distributed (env is the
+                # transport; also covers InitProcessGroupKwargs). The bootstrap
+                # runs ONCE — passing this after it is a silent no-op, so fail.
+                if PartialState._shared_state:
+                    raise ValueError(
+                        "DistributedInitKwargs must be passed before any "
+                        "Accelerator/PartialState is created — the process "
+                        "group is already initialized."
+                    )
+                if handler.coordinator_address:
+                    os.environ["ACCELERATE_COORDINATOR_ADDRESS"] = handler.coordinator_address
+                if handler.num_processes is not None:
+                    os.environ["ACCELERATE_NUM_PROCESSES"] = str(handler.num_processes)
+                if handler.process_id is not None:
+                    os.environ["ACCELERATE_PROCESS_ID"] = str(handler.process_id)
+                os.environ["ACCELERATE_INIT_TIMEOUT"] = str(int(handler.timeout.total_seconds()))
 
         self.state = AcceleratorState(mixed_precision=mixed_precision, parallelism=parallelism)
         self.fsdp_plugin = fsdp_plugin
@@ -387,9 +409,11 @@ class Accelerator:
                     f"Llama/Bert); {type(model).__name__} has none. Use 'bf16' "
                     "or add the hook."
                 )
-            from .ops.fp8 import fp8_dot
+            from .ops.fp8 import fp8_dot, make_fp8_dot
 
-            model.dot_fn = fp8_dot
+            model.dot_fn = (
+                make_fp8_dot(margin=self.fp8_recipe.margin) if self.fp8_recipe is not None else fp8_dot
+            )
         elif hasattr(model, "dot_fn"):
             model.dot_fn = None
         if hasattr(model, "pipeline_fn"):

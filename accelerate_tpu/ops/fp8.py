@@ -22,22 +22,29 @@ import jax.numpy as jnp
 E4M3_MAX = 448.0  # largest finite float8_e4m3fn value
 
 
-def quantize_e4m3(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Per-tensor scale to the e4m3 range; returns (quantized, scale)."""
+def quantize_e4m3(x: jax.Array, margin: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Per-tensor scale to the e4m3 range (minus ``margin`` headroom bits);
+    returns (quantized, scale)."""
     amax = jnp.max(jnp.abs(x))
-    scale = jnp.maximum(amax, 1e-12) / E4M3_MAX
+    scale = jnp.maximum(amax, 1e-12) * (2.0**margin) / E4M3_MAX
     return (x / scale).astype(jnp.float8_e4m3fn), scale
 
 
-def fp8_dot(x: jax.Array, w: jax.Array) -> jax.Array:
-    """``x @ w`` with both operands in scaled e4m3, accumulating in fp32.
+def make_fp8_dot(margin: int = 0):
+    """Build the fp8 projection matmul with ``margin`` headroom bits in the
+    scale (FP8RecipeKwargs.margin — TE recipe parity)."""
 
-    ``x``: [..., K], ``w``: [K, N]. Output in ``x``'s dtype — drop-in for the
-    model zoo's projection matmuls.
-    """
-    orig_dtype = x.dtype
-    qx, sx = quantize_e4m3(x.astype(jnp.float32))
-    qw, sw = quantize_e4m3(w.astype(jnp.float32))
-    contract = (((x.ndim - 1,), (0,)), ((), ()))
-    out = jax.lax.dot_general(qx, qw, contract, preferred_element_type=jnp.float32)
-    return (out * (sx * sw)).astype(orig_dtype)
+    def dot(x: jax.Array, w: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        qx, sx = quantize_e4m3(x.astype(jnp.float32), margin)
+        qw, sw = quantize_e4m3(w.astype(jnp.float32), margin)
+        contract = (((x.ndim - 1,), (0,)), ((), ()))
+        out = jax.lax.dot_general(qx, qw, contract, preferred_element_type=jnp.float32)
+        return (out * (sx * sw)).astype(orig_dtype)
+
+    return dot
+
+
+# the default recipe: no margin. ``x``: [..., K], ``w``: [K, N]; output in
+# ``x``'s dtype — drop-in for the model zoo's projection matmuls.
+fp8_dot = make_fp8_dot()

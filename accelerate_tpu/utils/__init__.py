@@ -9,6 +9,8 @@ from .constants import (
 )
 from .dataclasses import (
     AutocastKwargs,
+    FP8RecipeKwargs,
+    InitProcessGroupKwargs,
     CompilationConfig,
     ComputeEnvironment,
     DistributedInitKwargs,

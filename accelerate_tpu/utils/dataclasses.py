@@ -119,6 +119,33 @@ class AutocastKwargs(KwargsHandler):
     cache_enabled: bool = True  # accepted for API parity; XLA caches compiles
 
 
+@dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """fp8 scaling recipe (reference FP8RecipeKwargs dataclasses.py:170,
+    TransformerEngine DelayedScaling). TPU semantics: per-tensor *current*
+    scaling; ``margin`` backs every scale off by 2^margin headroom bits.
+    ``fp8_format`` accepts "E4M3" or "HYBRID" — both run e4m3 forward compute
+    here (the reference's HYBRID e5m2 side covers quantized *gradients*,
+    which stay in the compute dtype on this stack)."""
+
+    margin: int = 0
+    fp8_format: str = "E4M3"
+
+    def __post_init__(self):
+        if self.fp8_format.upper() not in ("E4M3", "HYBRID"):
+            raise ValueError(f"fp8_format must be E4M3 or HYBRID, got {self.fp8_format!r}")
+
+
+@dataclass
+class InitProcessGroupKwargs(DistributedInitKwargs):
+    """Reference-named alias of ``DistributedInitKwargs`` (reference
+    dataclasses.py:90). ``backend``/``init_method`` are accepted for parity —
+    there is exactly one backend here."""
+
+    backend: Optional[str] = "xla"
+    init_method: Optional[str] = None
+
+
 # ---------------------------------------------------------------------------
 # Gradient accumulation / project bookkeeping
 # ---------------------------------------------------------------------------

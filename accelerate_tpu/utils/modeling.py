@@ -173,3 +173,12 @@ def compute_module_sizes(model, dtype_bytes: int = 4) -> dict[str, int]:
     sizes = named_component_sizes(model, dtype_bytes)
     sizes[""] = sum(sizes.values())
     return sizes
+
+
+def get_balanced_memory(model, max_memory: Optional[dict] = None, **kwargs) -> dict[str, int]:
+    """Parity shim (reference modeling.py:919): the reference balances layer
+    placement across N GPUs by computing a per-GPU budget; here GSPMD lays
+    model shards over the mesh automatically, so the only placement budget is
+    the device/cpu/disk split — which is ``get_max_memory``."""
+    del model, kwargs
+    return get_max_memory(max_memory)

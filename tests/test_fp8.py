@@ -124,3 +124,44 @@ def test_fp8_applies_under_pipeline():
         outs[precision] = float(jax.jit(lambda p: loss_fn(p, {"input_ids": ids}))(prepared.params))
     assert outs["fp8"] != outs["bf16"]
     assert abs(outs["fp8"] - outs["bf16"]) < 0.5
+
+
+def test_fp8_recipe_margin_adds_headroom():
+    from accelerate_tpu.ops.fp8 import E4M3_MAX, make_fp8_dot, quantize_e4m3
+    from accelerate_tpu.utils import FP8RecipeKwargs
+
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(8, 32)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32))
+    q0, s0 = quantize_e4m3(x)
+    q2, s2 = quantize_e4m3(x, margin=2)
+    # margin=2 backs the scale off 4x, leaving 2 headroom bits in the range
+    np.testing.assert_allclose(float(s2), float(s0) * 4.0, rtol=1e-6)
+    assert float(jnp.abs(q2.astype(jnp.float32)).max()) <= E4M3_MAX / 4 + 1e-6
+    # power-of-2 rescaling is rounding-lossless: the dot output is unchanged
+    np.testing.assert_array_equal(
+        np.asarray(make_fp8_dot(margin=2)(x, w)), np.asarray(make_fp8_dot()(x, w))
+    )
+    with pytest.raises(ValueError, match="fp8_format"):
+        FP8RecipeKwargs(fp8_format="E5M2")
+
+
+def test_fp8_recipe_kwargs_handler_wires_margin():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    from accelerate_tpu.utils import FP8RecipeKwargs
+
+    acc = Accelerator(mixed_precision="fp8", kwargs_handlers=[FP8RecipeKwargs(margin=1)])
+    model = Llama("llama-tiny")
+    acc.prepare(model)
+    from accelerate_tpu.ops.fp8 import fp8_dot
+
+    assert model.dot_fn is not fp8_dot  # recipe-built dot, not the default
+    ids = jnp.asarray(np.random.default_rng(7).integers(0, 1024, (2, 8)), jnp.int32)
+    loss = jax.jit(lambda p: Llama.loss_fn(model)(p, {"input_ids": ids}))(
+        acc._models[-1].params
+    )
+    assert np.isfinite(float(loss))

@@ -99,3 +99,20 @@ def test_data_sharding_spec():
     x = jax.device_put(np.zeros((16, 4), np.float32), sharding)
     # batch axis split over data(4) x fsdp(2) = 8 ways
     assert len(x.sharding.device_set) == 8
+
+
+def test_distributed_init_kwargs_export_env(monkeypatch):
+    """DistributedInitKwargs/InitProcessGroupKwargs reach the bootstrap env."""
+    import datetime
+    import os
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import InitProcessGroupKwargs
+
+    # setenv first so monkeypatch records the (absent) original and restores
+    # it at teardown — the production write below is plain os.environ
+    monkeypatch.setenv("ACCELERATE_INIT_TIMEOUT", "sentinel")
+    monkeypatch.delenv("ACCELERATE_INIT_TIMEOUT")
+    handler = InitProcessGroupKwargs(timeout=datetime.timedelta(seconds=123))
+    Accelerator(kwargs_handlers=[handler])
+    assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "123"
