@@ -47,10 +47,10 @@ from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
     CompilationConfig,
-    DistributedInitKwargs,
     FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
     KwargsHandler,
     LossScaleKwargs,
     MixedPrecisionPolicy,
@@ -173,23 +173,28 @@ class Accelerator:
                 self.loss_scale_kwargs = handler
             elif isinstance(handler, FP8RecipeKwargs):
                 self.fp8_recipe = handler
-            elif isinstance(handler, DistributedInitKwargs):
+            elif isinstance(handler, InitProcessGroupKwargs):
                 # consumed by PartialState._bootstrap_distributed (env is the
-                # transport; also covers InitProcessGroupKwargs). The bootstrap
-                # runs ONCE — passing this after it is a silent no-op, so fail.
-                if PartialState._shared_state:
+                # transport; also covers DistributedInitKwargs). The rendezvous
+                # runs ONCE — passing this after it is a silent no-op, so fail
+                # (an early PartialState in a single process is fine: no
+                # rendezvous happened, the env still reaches any later one).
+                import jax
+
+                if jax.distributed.is_initialized():
                     raise ValueError(
-                        "DistributedInitKwargs must be passed before any "
-                        "Accelerator/PartialState is created — the process "
-                        "group is already initialized."
+                        "InitProcessGroupKwargs must be passed before the "
+                        "distributed rendezvous — jax.distributed is already "
+                        "initialized."
                     )
-                if handler.coordinator_address:
+                if getattr(handler, "coordinator_address", None):
                     os.environ["ACCELERATE_COORDINATOR_ADDRESS"] = handler.coordinator_address
-                if handler.num_processes is not None:
+                if getattr(handler, "num_processes", None) is not None:
                     os.environ["ACCELERATE_NUM_PROCESSES"] = str(handler.num_processes)
-                if handler.process_id is not None:
+                if getattr(handler, "process_id", None) is not None:
                     os.environ["ACCELERATE_PROCESS_ID"] = str(handler.process_id)
-                os.environ["ACCELERATE_INIT_TIMEOUT"] = str(int(handler.timeout.total_seconds()))
+                if handler.timeout is not None:
+                    os.environ["ACCELERATE_INIT_TIMEOUT"] = str(int(handler.timeout.total_seconds()))
 
         self.state = AcceleratorState(mixed_precision=mixed_precision, parallelism=parallelism)
         self.fsdp_plugin = fsdp_plugin

@@ -45,6 +45,12 @@ def is_initialized() -> bool:
     return AcceleratorState._shared_state != {}
 
 
+def _init_timeout_kwargs() -> dict[str, int]:
+    """ACCELERATE_INIT_TIMEOUT → jax.distributed.initialize kwargs (if set)."""
+    timeout = os.environ.get("ACCELERATE_INIT_TIMEOUT")
+    return {"initialization_timeout": int(timeout)} if timeout else {}
+
+
 class PartialState:
     """Topology bootstrap singleton.
 
@@ -90,22 +96,18 @@ class PartialState:
             # backend and defeat distributed init, so ask the distributed
             # module itself whether it is live.
             if not jax.distributed.is_initialized():
-                timeout = os.environ.get("ACCELERATE_INIT_TIMEOUT")
                 jax.distributed.initialize(
                     coordinator_address=coordinator,
                     num_processes=num_processes,
                     process_id=process_id,
-                    **({"initialization_timeout": int(timeout)} if timeout else {}),
+                    **_init_timeout_kwargs(),
                 )
         elif parse_flag_from_env("ACCELERATE_IN_TPU_POD"):
             # pod-launch path: no explicit coordinator — every worker runs the
             # identical command and jax self-discovers coordinator/process_id/
             # process count from the TPU pod metadata (argless initialize)
             if not jax.distributed.is_initialized():
-                timeout = os.environ.get("ACCELERATE_INIT_TIMEOUT")
-                jax.distributed.initialize(
-                    **({"initialization_timeout": int(timeout)} if timeout else {})
-                )
+                jax.distributed.initialize(**_init_timeout_kwargs())
         self.backend = "xla"
         self.device = jax.local_devices()[0]
         self.initialized = True

@@ -60,8 +60,15 @@ DEFAULT_LAUNCH_COMMAND = get_launch_command()
 
 def execute_subprocess(cmd: Sequence[str], env: dict | None = None, timeout: int = 360) -> subprocess.CompletedProcess:
     """Run a command, raising with captured output on failure (testing.py:534)."""
+    child_env = dict(env) if env is not None else os.environ.copy()
+    # the package may be run straight from a checkout without being installed:
+    # make sure children can import it
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    child_env["PYTHONPATH"] = (
+        pkg_root + os.pathsep + child_env["PYTHONPATH"] if child_env.get("PYTHONPATH") else pkg_root
+    )
     result = subprocess.run(
-        list(cmd), env=env or os.environ.copy(), capture_output=True, text=True, timeout=timeout
+        list(cmd), env=child_env, capture_output=True, text=True, timeout=timeout
     )
     if result.returncode != 0:
         raise RuntimeError(

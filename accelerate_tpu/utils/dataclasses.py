@@ -84,17 +84,30 @@ class KwargsHandler:
 
 
 @dataclass
-class DistributedInitKwargs(KwargsHandler):
+class InitProcessGroupKwargs(KwargsHandler):
+    """Rendezvous knobs, reference-compatible (reference dataclasses.py:90):
+    positional order is ``(backend, init_method, timeout)`` so migrated calls
+    like ``InitProcessGroupKwargs("gloo")`` keep meaning what they meant.
+    ``backend``/``init_method`` are accepted and ignored — there is exactly
+    one control plane here (the JAX coordination service). ``timeout=None``
+    defers to jax.distributed's own default instead of exporting one."""
+
+    backend: Optional[str] = "xla"
+    init_method: Optional[str] = None
+    timeout: Optional[timedelta] = None
+
+
+@dataclass
+class DistributedInitKwargs(InitProcessGroupKwargs):
     """Multi-host bootstrap knobs, fed to jax.distributed.initialize.
 
-    Replaces InitProcessGroupKwargs (reference dataclasses.py:232): there is no
-    backend choice — the control plane is always the JAX coordination service.
+    Extends :class:`InitProcessGroupKwargs` with the coordinator fields the
+    JAX control plane actually uses (pass them by keyword).
     """
 
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
-    timeout: timedelta = field(default_factory=lambda: timedelta(seconds=1800))
 
 
 @dataclass
@@ -134,16 +147,10 @@ class FP8RecipeKwargs(KwargsHandler):
     def __post_init__(self):
         if self.fp8_format.upper() not in ("E4M3", "HYBRID"):
             raise ValueError(f"fp8_format must be E4M3 or HYBRID, got {self.fp8_format!r}")
-
-
-@dataclass
-class InitProcessGroupKwargs(DistributedInitKwargs):
-    """Reference-named alias of ``DistributedInitKwargs`` (reference
-    dataclasses.py:90). ``backend``/``init_method`` are accepted for parity —
-    there is exactly one backend here."""
-
-    backend: Optional[str] = "xla"
-    init_method: Optional[str] = None
+        if self.margin < 0:
+            # a negative margin inflates values past e4m3's finite range and
+            # quantizes to NaN (e4m3 has no inf) — reject at construction
+            raise ValueError(f"margin must be >= 0, got {self.margin}")
 
 
 # ---------------------------------------------------------------------------

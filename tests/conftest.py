@@ -16,6 +16,15 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Subprocess-based tests (examples, launch, multi-process) must import the
+# package without it being pip-installed: export the repo root to children.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ["PYTHONPATH"] = (
+    _REPO_ROOT + os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH")
+    else _REPO_ROOT
+)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

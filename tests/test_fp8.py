@@ -144,6 +144,9 @@ def test_fp8_recipe_margin_adds_headroom():
     )
     with pytest.raises(ValueError, match="fp8_format"):
         FP8RecipeKwargs(fp8_format="E5M2")
+    with pytest.raises(ValueError, match="margin"):
+        # negative margin would overflow e4m3's finite range into NaN
+        FP8RecipeKwargs(margin=-2)
 
 
 def test_fp8_recipe_kwargs_handler_wires_margin():

@@ -116,3 +116,35 @@ def test_distributed_init_kwargs_export_env(monkeypatch):
     handler = InitProcessGroupKwargs(timeout=datetime.timedelta(seconds=123))
     Accelerator(kwargs_handlers=[handler])
     assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "123"
+
+
+def test_init_process_group_kwargs_reference_positional_order(monkeypatch):
+    """Reference signature is (backend, init_method, timeout): a migrated
+    positional call must not leak 'gloo' into the coordinator address."""
+    import datetime
+    import os
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import InitProcessGroupKwargs
+
+    monkeypatch.setenv("ACCELERATE_COORDINATOR_ADDRESS", "sentinel")
+    monkeypatch.delenv("ACCELERATE_COORDINATOR_ADDRESS")
+    monkeypatch.setenv("ACCELERATE_INIT_TIMEOUT", "60")
+    handler = InitProcessGroupKwargs("gloo", None, datetime.timedelta(seconds=7))
+    assert handler.backend == "gloo" and handler.timeout.total_seconds() == 7
+    Accelerator(kwargs_handlers=[handler])
+    assert "ACCELERATE_COORDINATOR_ADDRESS" not in os.environ
+    assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "7"
+
+
+def test_init_process_group_kwargs_default_timeout_keeps_env(monkeypatch):
+    """A handler with no explicit timeout must not clobber an operator-set
+    ACCELERATE_INIT_TIMEOUT."""
+    import os
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import InitProcessGroupKwargs
+
+    monkeypatch.setenv("ACCELERATE_INIT_TIMEOUT", "60")
+    Accelerator(kwargs_handlers=[InitProcessGroupKwargs()])
+    assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "60"
