@@ -16,17 +16,20 @@ Transfer design: each offloaded layer is *packed into one contiguous host
 buffer* at dispatch time, so streaming a layer is a single DMA (the reference
 moves every tensor separately through AlignDevicesHook — hooks.py:328-358);
 unpacking into the nine weight views happens on-device inside the jitted
-layer program, where slicing is HBM-bandwidth cheap.
+layer program, where slicing is HBM-bandwidth cheap. Layers stream and
+execute in GROUPS (one jit program per group) to amortize per-program
+dispatch latency; the group size is derived from ``stream_window_bytes``.
 
 Memory invariant (benchmarks/README.md:44-46): device HBM holds the resident
-components + at most two streamed layer buffers; host RAM holds only the
-offloaded components (memmap-backed when from disk).
+components + at most two streamed layer *groups* (double buffer) — bounded by
+``stream_window_bytes`` (default ``DEFAULT_STREAM_WINDOW_BYTES``); host RAM
+holds only the offloaded components (memmap-backed when from disk).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Mapping, Optional
+from typing import Any, Mapping, Optional, Union
 
 import numpy as np
 
@@ -42,6 +45,9 @@ from .utils.offload import load_offloaded_weight, offload_weight, save_offload_i
 
 logger = get_logger(__name__)
 
+# default HBM budget for the double-buffered streamed-layer window
+DEFAULT_STREAM_WINDOW_BYTES = 512 << 20
+
 # kept for llama HF-name mapping stability; the packer itself is generic
 LAYER_KEYS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate", "w_up", "w_down")
 
@@ -56,6 +62,16 @@ def init_empty_weights(model) -> Any:
 
 
 init_on_device = init_empty_weights  # parity alias
+
+
+def _np_dtype(dtype) -> np.dtype:
+    """numpy dtype for a jnp scalar type WITHOUT a device round trip.
+
+    ``np.asarray(jnp.zeros((), dtype))`` would run a device op and fetch it —
+    on tunneled TPU transports a single device→host fetch permanently drops
+    host→device DMA to ~10 MB/s, wrecking the streaming path that follows.
+    """
+    return np.dtype(dtype)
 
 
 def _device_put_packed(buf):
@@ -111,7 +127,7 @@ class LayerPacker:
         return cls({k: np.empty(s, np.int8) for k, s in shapes.items()}, dtype)
 
     def pack(self, layer: Mapping[str, Any]) -> np.ndarray:
-        np_dtype = np.asarray(jnp.zeros((), self.dtype)).dtype
+        np_dtype = _np_dtype(self.dtype)
         buf = np.empty((self.total,), np_dtype)
         flat = dict(_flat_items(layer))
         for key, (offset, size) in self.offsets.items():
@@ -132,31 +148,70 @@ class _LayerStreamer:
     before layer i's compute is awaited — the H2D copy rides DMA while the
     MXU works)."""
 
-    def __init__(self, model, layer_buffers, layer_on_device, packer: LayerPacker, dtype):
+    def __init__(
+        self,
+        model,
+        layer_buffers,
+        layer_on_device,
+        packer: LayerPacker,
+        dtype,
+        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
+    ):
         self.model = model
         self.layer_buffers = layer_buffers  # packed 1D host buffers (np/memmap) or device arrays
         self.layer_on_device = layer_on_device
         self.packer = packer
         self.dtype = dtype
         self.hf_device_map: dict[str, str] = {}
+        # Layers are streamed and EXECUTED in groups: one jitted program per
+        # group instead of per layer. Remote/tunneled TPU transports pay tens
+        # of ms of dispatch latency per program — per-layer dispatch dominates
+        # decode otherwise. The group size is bounded by the HBM streaming
+        # window: peak streaming memory ≈ 2 × group_size × layer_bytes
+        # (double buffer), kept under ``stream_window_bytes``.
+        self.stream_window_bytes = stream_window_bytes
+        layer_bytes = self._layer_bytes()
+        per_group = max(1, (stream_window_bytes // 2) // max(layer_bytes, 1))
+        self.group_size = int(min(per_group, max(len(layer_buffers), 1)))
+
+    def _layer_bytes(self) -> int:
+        """Packed on-device footprint of one layer buffer."""
+        packer = self.packer
+        if isinstance(packer, QuantizedLayerPacker):
+            return int(packer.q_total + packer.f_total * 4)
+        return int(packer.total * _np_dtype(packer.dtype).itemsize)
 
     def _put(self, buf):
         return _device_put_packed(buf)
 
+    def _put_group(self, idx: list[int]):
+        """Issue async transfers for every offloaded layer in the group."""
+        return [
+            self.layer_buffers[i] if self.layer_on_device[i] else self._put(self.layer_buffers[i])
+            for i in idx
+        ]
+
+    def _group_indices(self) -> list[list[int]]:
+        L = len(self.layer_buffers)
+        g = self.group_size
+        return [list(range(i, min(i + g, L))) for i in range(0, L, g)]
+
+    def _iter_device_layer_groups(self):
+        """Yield lists of on-device packed buffers, double-buffering groups:
+        group i+1's H2D transfers are in flight while group i executes."""
+        groups = self._group_indices()
+        next_bufs = None
+        for gi, idx in enumerate(groups):
+            current = next_bufs if next_bufs is not None else self._put_group(idx)
+            next_bufs = None
+            if gi + 1 < len(groups):
+                next_bufs = self._put_group(groups[gi + 1])  # async: overlaps compute
+            yield current
+
     def _iter_device_layers(self):
         """Yield each layer's packed device buffer, double-buffering transfers."""
-        L = len(self.layer_buffers)
-        next_buf = None
-        for i in range(L):
-            if self.layer_on_device[i]:
-                current = self.layer_buffers[i]
-            else:
-                current = next_buf if next_buf is not None else self._put(self.layer_buffers[i])
-            next_buf = None
-            j = i + 1
-            if j < L and not self.layer_on_device[j]:
-                next_buf = self._put(self.layer_buffers[j])  # async: overlaps compute
-            yield current
+        for bufs in self._iter_device_layer_groups():
+            yield from bufs
 
 
 class QuantizedLayerPacker:
@@ -254,12 +309,18 @@ class StreamedCausalLM(_LayerStreamer):
         layer_on_device: list[bool],
         packer: LayerPacker,
         dtype=jnp.bfloat16,
+        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
     ):
-        super().__init__(model, layer_buffers, layer_on_device, packer, dtype)
+        super().__init__(
+            model, layer_buffers, layer_on_device, packer, dtype,
+            stream_window_bytes=stream_window_bytes,
+        )
         self.config: TransformerConfig = model.config
         self.resident = resident
-        self._layer_fn = None
-        self._cached_layer_fn = None
+        self._group_fns: dict = {}
+        self._cached_group_fns: dict = {}
+        self._prelude_fns: dict = {}
+        self._tail_fns: dict = {}
 
     def _resident(self, key: str) -> jax.Array:
         """Fetch a non-layer component, streaming it if device_map kept it on
@@ -269,20 +330,27 @@ class StreamedCausalLM(_LayerStreamer):
             return value
         return self._put(np.asarray(value))
 
-    def _get_layer_fn(self):
-        # keyed on dot_fn: toggling fp8 on the model must recompile
+    def _get_group_fn(self, n: int):
+        """Jitted program applying ``n`` streamed layers (no KV cache).
+
+        One dispatch per group instead of per layer — remote TPU transports
+        pay tens of ms per program dispatch.
+        """
+        # keyed on dot_fn too: toggling fp8 on the model must recompile
         dot_fn = getattr(self.model, "dot_fn", None)
-        if self._layer_fn is None or self._layer_fn[0] is not dot_fn:
+        key = (n,)
+        if key not in self._group_fns or self._group_fns[key][0] is not dot_fn:
             cfg = self.config
             unpack = self.packer.unpack
 
             @jax.jit
-            def layer_fn(h, buf, cos, sin, mask):
-                h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True, dot_fn=dot_fn)
+            def group_fn(h, bufs, cos, sin, mask):
+                for buf in bufs:
+                    h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True, dot_fn=dot_fn)
                 return h
 
-            self._layer_fn = (dot_fn, layer_fn)
-        return self._layer_fn[1]
+            self._group_fns[key] = (dot_fn, group_fn)
+        return self._group_fns[key][1]
 
     def __call__(self, input_ids, attention_mask: Optional[Any] = None) -> jax.Array:
         """Full-sequence logits [B, S, V]."""
@@ -295,9 +363,8 @@ class StreamedCausalLM(_LayerStreamer):
         mask = None
         if attention_mask is not None:
             mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
-        layer_fn = self._get_layer_fn()
-        for buf in self._iter_device_layers():
-            h = layer_fn(h, buf, cos, sin, mask)
+        for bufs in self._iter_device_layer_groups():
+            h = self._get_group_fn(len(bufs))(h, tuple(bufs), cos, sin, mask)
         h = rms_norm(h, self._resident("final_norm"), cfg.norm_eps)
         head = (
             self._resident("embed_tokens").T
@@ -306,27 +373,93 @@ class StreamedCausalLM(_LayerStreamer):
         )
         return (h @ head.astype(h.dtype)).astype(jnp.float32)
 
-    def _get_cached_layer_fn(self):
+    def _get_cached_group_fn(self, n: int):
+        """Jitted program applying ``n`` streamed layers with KV caches."""
         dot_fn = getattr(self.model, "dot_fn", None)
-        if self._cached_layer_fn is None or self._cached_layer_fn[0] is not dot_fn:
+        key = (n,)
+        if key not in self._cached_group_fns or self._cached_group_fns[key][0] is not dot_fn:
             cfg = self.config
             unpack = self.packer.unpack
 
             @jax.jit
-            def fn(h, buf, cache, length, cos, sin, mask):
-                h, new_cache = decoder_layer(
-                    cfg, h, unpack(buf), cos, sin, mask,
-                    cache={"k": cache["k"], "v": cache["v"], "length": length},
-                    dot_fn=dot_fn,
-                )
-                return h, {"k": new_cache["k"], "v": new_cache["v"]}
+            def fn(h, bufs, caches, length, cos, sin, mask):
+                new_caches = []
+                for buf, cache in zip(bufs, caches):
+                    h, nc = decoder_layer(
+                        cfg, h, unpack(buf), cos, sin, mask,
+                        cache={"k": cache["k"], "v": cache["v"], "length": length},
+                        dot_fn=dot_fn,
+                    )
+                    new_caches.append({"k": nc["k"], "v": nc["v"]})
+                return h, tuple(new_caches)
 
-            self._cached_layer_fn = (dot_fn, fn)
-        return self._cached_layer_fn[1]
+            self._cached_group_fns[key] = (dot_fn, fn)
+        return self._cached_group_fns[key][1]
 
-    def generate(self, input_ids, max_new_tokens: int = 20, temperature: float = 0.0, rng=None) -> np.ndarray:
+    def _get_prelude_fn(self, max_len: int):
+        """Jitted per-token prelude: embed lookup + RoPE tables + KV mask.
+
+        One fused dispatch instead of ~10 eager ops — eager dispatch latency
+        through a remote TPU transport is tens of ms per op, which would
+        dominate the per-token budget.
+        """
+        if max_len not in self._prelude_fns:
+            cfg = self.config
+            dtype = self.dtype
+
+            @jax.jit
+            def prelude(embed, current, length):
+                blk = current.shape[1]
+                h = jnp.take(embed, current, axis=0).astype(dtype)
+                positions = length + jnp.arange(blk)[None, :]
+                cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+                q_pos = length + jnp.arange(blk)
+                mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
+                return h, cos, sin, mask
+
+            self._prelude_fns[max_len] = prelude
+        return self._prelude_fns[max_len]
+
+    def _get_tail_fn(self, sampled: bool):
+        """Jitted per-token tail: final norm + LM head + next-token choice.
+
+        Also advances ``length`` and the PRNG key on device, so the decode
+        loop never materializes a host value (a single device→host fetch can
+        permanently degrade DMA on tunneled transports; see ``_np_dtype``).
+        """
+        if sampled not in self._tail_fns:
+            cfg = self.config
+
+            @jax.jit
+            def tail(h, norm_w, head_src, length, rng, temperature):
+                h = rms_norm(h, norm_w, cfg.norm_eps)
+                head = head_src.T if cfg.tie_embeddings else head_src
+                logits = (h[:, -1] @ head.astype(h.dtype)).astype(jnp.float32)
+                if sampled:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                return nxt.astype(jnp.int32), length + h.shape[1], rng
+
+            self._tail_fns[sampled] = tail
+        return self._tail_fns[sampled]
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 20,
+        temperature: float = 0.0,
+        rng=None,
+        return_device: bool = False,
+    ) -> Union[np.ndarray, jax.Array]:
         """Greedy/sampled decode; each token streams the offloaded layers once
-        (the reference's per-token cost model, benchmarks/README.md:39-42)."""
+        (the reference's per-token cost model, benchmarks/README.md:39-42).
+
+        The loop is fetch-free: tokens accumulate on device and convert to
+        numpy in one transfer at the end (``return_device=True`` skips even
+        that — callers timing the decode fetch after the clock stops).
+        """
         cfg = self.config
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
@@ -340,38 +473,34 @@ class StreamedCausalLM(_LayerStreamer):
         ]
         if rng is None:
             rng = jax.random.key(0)
+        temp = jnp.asarray(max(temperature, 1e-6), jnp.float32)
 
-        cached_layer_fn = self._get_cached_layer_fn()
+        prelude = self._get_prelude_fn(max_len)
+        tail = self._get_tail_fn(temperature > 0.0)
+        embed = self._resident("embed_tokens")
+        norm_w = self._resident("final_norm")
+        head_src = embed if cfg.tie_embeddings else self._resident("lm_head")
+        groups = self._group_indices()
+
         tokens = [input_ids]
         current = input_ids
-        length = 0
+        length = jnp.zeros((), jnp.int32)
         # max_new_tokens forwards total: prefill samples token 1, then one
         # decode forward per remaining token (no discarded final pass).
         for _ in range(max_new_tokens):
-            blk = current.shape[1]
-            h = jnp.take(self._resident("embed_tokens"), current, axis=0).astype(self.dtype)
-            positions = length + jnp.arange(blk)[None, :]
-            cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
-            q_pos = length + jnp.arange(blk)
-            mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
-            for i, buf in enumerate(self._iter_device_layers()):
-                h, caches[i] = cached_layer_fn(h, buf, caches[i], jnp.int32(length), cos, sin, mask)
-            h = rms_norm(h, self._resident("final_norm"), cfg.norm_eps)
-            head = (
-                self._resident("embed_tokens").T
-                if cfg.tie_embeddings
-                else self._resident("lm_head")
-            )
-            logits = (h[:, -1] @ head.astype(h.dtype)).astype(jnp.float32)
-            length += blk
-            if temperature <= 0.0:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, logits / temperature, axis=-1).astype(jnp.int32)
+            h, cos, sin, mask = prelude(embed, current, length)
+            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
+                gcaches = tuple(caches[i] for i in idx)
+                h, new_caches = self._get_cached_group_fn(len(idx))(
+                    h, tuple(bufs), gcaches, length, cos, sin, mask
+                )
+                for i, nc in zip(idx, new_caches):
+                    caches[i] = nc
+            nxt, length, rng = tail(h, norm_w, head_src, length, rng, temp)
             current = nxt[:, None]
             tokens.append(current)
-        return np.concatenate([np.asarray(t) for t in tokens], axis=1)
+        out = jnp.concatenate(tokens, axis=1)
+        return out if return_device else np.asarray(out)
 
 
 class StreamedModel(_LayerStreamer):
@@ -388,11 +517,17 @@ class StreamedModel(_LayerStreamer):
     (hooks.py:212-382) without touching the model's code.
     """
 
-    def __init__(self, model, resident_flat, layer_buffers, layer_on_device, packer, dtype):
-        super().__init__(model, layer_buffers, layer_on_device, packer, dtype)
+    def __init__(
+        self, model, resident_flat, layer_buffers, layer_on_device, packer, dtype,
+        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
+    ):
+        super().__init__(
+            model, layer_buffers, layer_on_device, packer, dtype,
+            stream_window_bytes=stream_window_bytes,
+        )
         self.config = getattr(model, "config", None)
         self._resident_flat = resident_flat
-        self._layer_fn = None
+        self._group_fns: dict = {}
 
     def resident_tree(self) -> dict:
         """Nested resident params, streaming host/disk leaves to the device."""
@@ -403,25 +538,30 @@ class StreamedModel(_LayerStreamer):
             }
         )
 
-    def __call__(self, *args, **kwargs):
-        resident = self.resident_tree()
-        carry = self.model.stream_prefix(resident, *args, **kwargs)
-        if self._layer_fn is None:
+    def _get_group_fn(self, n: int):
+        if n not in self._group_fns:
             unpack, stream_layer = self.packer.unpack, self.model.stream_layer
 
             @jax.jit
-            def layer_fn(carry, buf):
-                return stream_layer(carry, unpack(buf))
+            def group_fn(carry, bufs):
+                for buf in bufs:
+                    carry = stream_layer(carry, unpack(buf))
+                return carry
 
-            self._layer_fn = layer_fn
-        for buf in self._iter_device_layers():
-            carry = self._layer_fn(carry, buf)
+            self._group_fns[n] = group_fn
+        return self._group_fns[n]
+
+    def __call__(self, *args, **kwargs):
+        resident = self.resident_tree()
+        carry = self.model.stream_prefix(resident, *args, **kwargs)
+        for bufs in self._iter_device_layer_groups():
+            carry = self._get_group_fn(len(bufs))(carry, tuple(bufs))
         return self.model.stream_suffix(resident, carry)
 
 
 def _place_components(params, device_map, offload_dir, dtype, quantization=None):
     """Shared placement: resident leaves + packed per-layer buffers."""
-    np_dtype = np.asarray(jnp.zeros((), dtype)).dtype
+    np_dtype = _np_dtype(dtype)
 
     resident: dict[str, Any] = {}
     for key, leaf in _flat_items({k: v for k, v in params.items() if k != "layers"}):
@@ -498,6 +638,7 @@ def dispatch_model(
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
     quantization=None,  # utils.quantization.QuantizationConfig → W8A16/W4A16 layers
+    stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,  # HBM budget for streamed layer groups
 ):
     """Place components per ``device_map`` and return the streaming executor.
 
@@ -512,7 +653,7 @@ def dispatch_model(
             "protocol (stream_prefix/stream_layer/stream_suffix) or use a "
             "llama-family model."
         )
-    dtype_bytes: float = 2 if "16" in str(dtype) else np.dtype(np.asarray(jnp.zeros((), dtype)).dtype).itemsize
+    dtype_bytes: float = _np_dtype(dtype).itemsize
     # auto placement sizes layers at their QUANTIZED footprint (resident
     # components stay full precision), or capacity is mis-estimated 2-4x
     layer_dtype_bytes = quantization.bits / 8 if quantization is not None else None
@@ -527,9 +668,15 @@ def dispatch_model(
     )
 
     if isinstance(model, Llama):
-        dispatched = StreamedCausalLM(model, resident, layer_buffers, layer_on_device, packer, dtype=dtype)
+        dispatched = StreamedCausalLM(
+            model, resident, layer_buffers, layer_on_device, packer, dtype=dtype,
+            stream_window_bytes=stream_window_bytes,
+        )
     else:
-        dispatched = StreamedModel(model, resident, layer_buffers, layer_on_device, packer, dtype)
+        dispatched = StreamedModel(
+            model, resident, layer_buffers, layer_on_device, packer, dtype,
+            stream_window_bytes=stream_window_bytes,
+        )
     dispatched.hf_device_map = dict(device_map)
     return dispatched
 
@@ -560,6 +707,7 @@ def load_checkpoint_and_dispatch(
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
+    stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
 ) -> StreamedCausalLM:
     """Load weights and dispatch (big_modeling.py:498). Accepts both the
     native flat layout ("layers/wq" stacked tensors) and HuggingFace/torch
@@ -569,7 +717,8 @@ def load_checkpoint_and_dispatch(
 
     params = load_checkpoint_in_model(model, checkpoint)
     return dispatch_model(
-        model, params, device_map=device_map, max_memory=max_memory, offload_dir=offload_dir, dtype=dtype
+        model, params, device_map=device_map, max_memory=max_memory, offload_dir=offload_dir,
+        dtype=dtype, stream_window_bytes=stream_window_bytes,
     )
 
 

@@ -1,6 +1,7 @@
 from .attention import dot_product_attention, rotary_embedding
 from .bert import Bert
 from .config import TransformerConfig, get_config, list_models, param_count, register_config
+from .generation import generate
 from .llama import Llama
 from .moe import MoEBlock
 

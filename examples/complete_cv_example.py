@@ -1,0 +1,126 @@
+"""The kitchen-sink vision loop (reference examples/complete_cv_example.py):
+the cv_example convnet plus tracking, checkpointing with mid-training resume,
+LR scheduling, and exact distributed metrics, all behind CLI flags.
+
+Run:
+    python examples/complete_cv_example.py --with_tracking \
+        --checkpointing_steps epoch --output_dir /tmp/cv_run
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cv_example import ShapesDataset, SmallConvNet, loss_fn
+from example_utils import train_eval_split
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+EVAL_BATCH_SIZE = 16
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Complete vision training-loop example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=[None, "no", "fp16", "bf16"])
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument(
+        "--checkpointing_steps", type=str, default=None,
+        help='"epoch", or an integer number of batches between checkpoints',
+    )
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--output_dir", type=str, default=None)
+    args = parser.parse_args(argv)
+    if args.checkpointing_steps or args.with_tracking:
+        assert args.output_dir, "--output_dir is required with tracking/checkpointing"
+
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(project_dir=args.output_dir, logging_dir=args.output_dir),
+    )
+    set_seed(42)
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", vars(args))
+
+    train_set, eval_set = train_eval_split(ShapesDataset())
+
+    def schedule(count):
+        return args.lr / (1 + 0.05 * count)
+
+    model, optimizer, train_loader, scheduler = accelerator.prepare(
+        SmallConvNet(),
+        optax.adam(schedule),
+        accelerator.prepare_data_loader(train_set, batch_size=args.batch_size, shuffle=True, seed=42),
+        schedule,
+    )
+    eval_loader = accelerator.prepare_data_loader(eval_set, batch_size=EVAL_BATCH_SIZE)
+
+    class Progress:
+        step = 0
+
+        def state_dict(self):
+            return {"step": self.step}
+
+        def load_state_dict(self, state):
+            self.step = state["step"]
+
+    progress = Progress()
+    accelerator.register_for_checkpointing(progress)
+    batches_per_epoch = max(len(train_loader), 1)
+    start_epoch = skip_batches = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        start_epoch = progress.step // batches_per_epoch
+        skip_batches = progress.step % batches_per_epoch
+        accelerator.print(f"resumed at epoch {start_epoch}, step {progress.step}")
+
+    for epoch in range(start_epoch, args.num_epochs):
+        train_loader.set_epoch(epoch)
+        loader = train_loader
+        if epoch == start_epoch and skip_batches:
+            loader = accelerator.skip_first_batches(train_loader, skip_batches)
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(loss_fn, batch)
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+            progress.step += 1
+            if args.with_tracking:
+                accelerator.log({"train_loss": float(loss)}, step=progress.step)
+            if args.checkpointing_steps and args.checkpointing_steps != "epoch":
+                if progress.step % int(args.checkpointing_steps) == 0:
+                    accelerator.save_state(os.path.join(args.output_dir, f"step_{progress.step}"))
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.output_dir, f"epoch_{epoch}"))
+
+        correct = total = 0
+        for batch in eval_loader:
+            logits = SmallConvNet.apply(model.params, batch["image"])
+            preds, refs = accelerator.gather_for_metrics((jnp.argmax(logits, -1), batch["label"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        accuracy = correct / total
+        accelerator.print(f"epoch {epoch}: accuracy={accuracy:.3f}")
+        if args.with_tracking:
+            accelerator.log({"accuracy": accuracy}, step=progress.step)
+
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Multi-host TPU job under Slurm (reference examples/slurm/submit_multinode.sh).
+#
+# One task per HOST (a host drives all of its local TPU chips — there is no
+# per-chip process fan-out on this stack). Rank 0's node is the JAX
+# coordination-service rendezvous point.
+
+#SBATCH --job-name=accelerate-tpu-multinode
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # number of TPU hosts
+#SBATCH --ntasks-per-node=1         # exactly one process per host
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+# source activate_environment.sh   # your venv with accelerate_tpu installed
+######################
+
+######################
+#### Set network #####
+######################
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+export COORDINATOR="${head_node_ip}:29500"
+######################
+
+export LAUNCHER="accelerate-tpu launch \
+    --num_processes $SLURM_NNODES \
+    --process_id \$SLURM_PROCID \
+    --coordinator_address $COORDINATOR \
+    --fsdp_size $SLURM_NNODES \
+    --mixed_precision bf16 \
+    "
+export SCRIPT="examples/complete_nlp_example.py"
+export SCRIPT_ARGS="--num_epochs 3 --output_dir /tmp/run --checkpointing_steps epoch"
+
+# srun expands $SLURM_PROCID per task, giving each host its rank
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Single-host TPU job under Slurm (reference examples/slurm/submit_multigpu.sh).
+#
+# One process drives every local TPU chip; the mesh axes are set by flags
+# (here: pure data parallelism over all chips).
+
+#SBATCH --job-name=accelerate-tpu-singlenode
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+# source activate_environment.sh   # your venv with accelerate_tpu installed
+
+accelerate-tpu launch \
+    --mixed_precision bf16 \
+    examples/nlp_example.py --num_epochs 3

@@ -137,6 +137,29 @@ def test_streamed_generate_matches_generate(tiny):
     streamed = cpu_offload(model, params, dtype=jnp.float32)
     got = streamed.generate(ids, max_new_tokens=4)
     np.testing.assert_array_equal(got, expected)
+    # return_device defers the single host fetch to the caller
+    dev = streamed.generate(ids, max_new_tokens=4, return_device=True)
+    np.testing.assert_array_equal(np.asarray(dev), expected)
+
+
+def test_streaming_group_size_invariance(tiny):
+    """Grouped layer execution (1 dispatch per group) must not change results;
+    a tiny window forces group_size=1, the default fuses all layers."""
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    model, params, ids, full_logits = tiny
+    cfg = model.config
+    dm = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
+    dm.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
+
+    wide = dispatch_model(model, params, dm, dtype=jnp.float32)
+    narrow = dispatch_model(model, params, dm, dtype=jnp.float32, stream_window_bytes=1)
+    assert narrow.group_size == 1 and wide.group_size > 1
+    np.testing.assert_allclose(np.asarray(wide(ids)), np.asarray(full_logits), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(narrow(ids)), np.asarray(full_logits), atol=1e-4)
+    np.testing.assert_array_equal(
+        wide.generate(ids, max_new_tokens=3), narrow.generate(ids, max_new_tokens=3)
+    )
 
 
 # -- generic (non-llama) dispatch via the stream protocol --------------------

@@ -127,3 +127,38 @@ def test_cross_validation_example():
     out = run_example("by_feature/cross_validation.py", "--num_folds", "2")
     assert "fold 1:" in out
     assert re.search(r"mean accuracy over 2 folds: [\d.]+", out)
+
+
+def test_complete_cv_example(tmp_path):
+    out = run_example(
+        "complete_cv_example.py", "--num_epochs", "1", "--with_tracking",
+        "--checkpointing_steps", "epoch", "--output_dir", str(tmp_path),
+    )
+    assert re.search(r"epoch 0: accuracy=[\d.]+", out)
+    assert os.path.exists(tmp_path / "epoch_0" / "model_0.safetensors")
+    out = run_example(
+        "complete_cv_example.py", "--num_epochs", "2",
+        "--resume_from_checkpoint", str(tmp_path / "epoch_0"), "--output_dir", str(tmp_path),
+    )
+    assert "resumed at epoch 1" in out
+    assert re.search(r"epoch 1: accuracy=[\d.]+", out)
+
+
+def test_fsdp_with_peak_mem_tracking_example():
+    out = run_example("by_feature/fsdp_with_peak_mem_tracking.py", "--num_epochs", "1")
+    assert re.search(r"epoch 0: (peak HBM|host RSS) [\d.]+ MiB", out)
+    assert re.search(r"epoch 0: \{'accuracy'", out)
+
+
+def test_big_model_inference_example(tmp_path):
+    out = run_example(
+        "inference/big_model_inference.py", "--model", "llama-tiny",
+        "--ckpt", str(tmp_path / "ckpt"), "--placement", "cpu", "--max_new_tokens", "4",
+    )
+    assert re.search(r"generation: [\d.]+ s/token", out)
+    assert "tokens:" in out
+
+
+def test_distributed_inference_example():
+    out = run_example("inference/distributed_inference.py", "--max_new_tokens", "4")
+    assert re.search(r"process 0 generated \d+ sequences", out)
