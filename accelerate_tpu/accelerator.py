@@ -432,8 +432,14 @@ class Accelerator:
                     if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
                     else 4 * self.mesh.shape[MESH_AXIS_PIPELINE]
                 )
+                virtual = (
+                    self.model_parallel_plugin.virtual_pipeline_stages
+                    if self.model_parallel_plugin is not None
+                    else 1
+                )
                 model.pipeline_fn = make_pipeline_layers_fn(
-                    model.config, self.mesh, num_micro, dot_fn=getattr(model, "dot_fn", None)
+                    model.config, self.mesh, num_micro,
+                    dot_fn=getattr(model, "dot_fn", None), virtual_stages=virtual,
                 )
             else:
                 model.pipeline_fn = None

@@ -208,11 +208,6 @@ class _LayerStreamer:
                 next_bufs = self._put_group(groups[gi + 1])  # async: overlaps compute
             yield current
 
-    def _iter_device_layers(self):
-        """Yield each layer's packed device buffer, double-buffering transfers."""
-        for bufs in self._iter_device_layer_groups():
-            yield from bufs
-
 
 class QuantizedLayerPacker:
     """Layer packer with weight-only int8/int4 quantization (reference

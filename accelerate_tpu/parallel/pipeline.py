@@ -3,26 +3,36 @@
 Parity-plus (SURVEY §2.6 PP row): the reference offers training PP only by
 delegating to Megatron-LM and inference PP via pippy's fx tracing
 (inference.py:126). Here PP is native: the stacked layer parameters are
-sharded on their leading (layer) dimension over the ``pipeline`` axis, and a
-GPipe schedule runs *inside one jit program* via ``shard_map``:
+sharded on their leading (layer) dimension over the ``pipeline`` axis, and
+the microbatch schedule runs *inside one jit program* via ``shard_map``:
 
 - the shard_map is manual over ONLY the ``pipeline`` axis (``axis_names``):
   tensor/fsdp/data stay in GSPMD auto mode, so Megatron-style TP matmuls and
   ZeRO-3 parameter sharding keep working *inside* each pipeline stage;
-- every stage holds L/P layers; activations (and each microbatch's attention
-  mask) hop stage→stage with ``ppermute`` over neighbor ICI links;
-- the microbatch loop is a ``lax.scan`` over M + P - 1 ticks — stage p works
-  on microbatch t-p at tick t, filling and draining like 1F1B's forward pass;
+- every device holds ``virtual_stages`` chunks of L/(v·P) layers (Megatron
+  interleaved/virtual stages, reference dataclasses.py:1246
+  ``num_layers_per_virtual_pipeline_stage``); activations (and each
+  microbatch's attention mask) hop stage→stage with ``ppermute`` over
+  neighbor ICI links, wrapping P-1 → 0 between chunks;
+- the schedule is computed at trace time by a deep-first greedy simulation
+  (consume the ring arrival if present, else inject the next microbatch) and
+  baked into per-(device, tick) index tables; a ``lax.scan`` over the ticks
+  executes it. The deep-first rule guarantees each produced activation is
+  consumed exactly one tick later, so one in-flight slot per device suffices;
 - backward is jax.grad through the scan: XLA reverses the ppermutes into the
   backward pipeline automatically (no hand-written schedule);
-- each stage's compute is wrapped in ``jax.checkpoint`` so only per-tick
+- each chunk's compute is wrapped in ``jax.checkpoint`` so only per-tick
   boundary activations stay live.
 
-Bubble fraction is (P-1)/(M+P-1) — pick num_microbatches >= 4*P for ~<20%
-overhead, as with any GPipe-family schedule.
+Bubble: with v = 1 the schedule is exactly GPipe — fraction (P-1)/(M+P-1).
+With v virtual stages each fill/drain tick costs 1/v of a full stage, so the
+fraction drops toward (P-1)/(vM+P-1)-ish; the schedule builder reports the
+exact idle fraction for the chosen (P, v, M).
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -37,28 +47,94 @@ def _is_narrow_float(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits < 32
 
 
-def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None):
+def build_interleaved_schedule(num_stages: int, virtual: int, num_microbatches: int):
+    """Static (device, tick) tables for the interleaved forward schedule.
+
+    Deep-first greedy: each device consumes its ring arrival when one exists
+    (arrivals are always deeper in the network than fresh injections), else
+    device 0 injects the next microbatch into virtual stage 0. Every
+    activation produced at tick t is consumed at tick t+1 on the next device
+    of the ring — one in-flight slot per device, like GPipe.
+
+    Returns ``(chunk, use_arrival, inject, emit, idle_fraction)`` — the first
+    four are [P, T] int arrays (-1 = not applicable at that tick).
+    """
+    Pn, v, M = num_stages, virtual, num_microbatches
+    S = v * Pn
+    arrive: list = [None] * Pn
+    next_inject = 0
+    done = 0
+    chunk_rows, use_rows, inj_rows, emit_rows = [], [], [], []
+    while done < M:
+        send: list = [None] * Pn
+        cc, uu, ii, ee = [-1] * Pn, [0] * Pn, [-1] * Pn, [-1] * Pn
+        for p in range(Pn):
+            if arrive[p] is not None:
+                m, s = arrive[p]
+                cc[p], uu[p] = s // Pn, 1
+                if s == S - 1:
+                    ee[p] = m
+                    done += 1
+                else:
+                    send[(p + 1) % Pn] = (m, s + 1)
+            elif p == 0 and next_inject < M:
+                m = next_inject
+                next_inject += 1
+                cc[p], ii[p] = 0, m
+                if S == 1:
+                    ee[p] = m
+                    done += 1
+                else:
+                    send[1 % Pn] = (m, 1)
+        arrive = send
+        chunk_rows.append(cc)
+        use_rows.append(uu)
+        inj_rows.append(ii)
+        emit_rows.append(ee)
+    T = len(chunk_rows)
+    tables = tuple(
+        np.asarray(rows, np.int32).T  # [T, P] → [P, T]
+        for rows in (chunk_rows, use_rows, inj_rows, emit_rows)
+    )
+    busy = int((tables[0] >= 0).sum())
+    idle_fraction = 1.0 - busy / float(Pn * T)
+    return (*tables, idle_fraction)
+
+
+def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None, virtual_stages: int = 1):
     """Build ``fn(stacked_layer_params, h, cos, sin, mask) -> h`` running the
     decoder stack as a pipeline over the ``pipeline`` mesh axis.
 
+    ``virtual_stages`` > 1 gives each device that many non-contiguous layer
+    chunks (Megatron interleaved schedule) — same math, smaller bubble.
+
     Constraints (v1): the ``sequence`` axis must be 1 (ring attention inside a
-    pipeline stage is a follow-up); layer count must divide the pipeline
-    size; cos/sin must be batch-invariant (default integer positions). The
-    microbatch count adapts downward (with a warning) when it does not
-    divide the batch.
+    pipeline stage is a follow-up); layer count must divide virtual_stages ×
+    pipeline size; cos/sin must be batch-invariant (default integer
+    positions). The microbatch count adapts downward (with a warning) when it
+    does not divide the batch.
     """
     from ..models.llama import decoder_layer
 
     if mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
         raise NotImplementedError("pipeline + sequence axes combined is not supported yet")
     nstages = mesh.shape[MESH_AXIS_PIPELINE]
-    if cfg.num_layers % nstages != 0:
-        raise ValueError(f"num_layers={cfg.num_layers} must divide pipeline size {nstages}")
+    v = virtual_stages
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    if cfg.num_layers % (v * nstages) != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide virtual_stages*pipeline "
+            f"= {v}*{nstages}"
+        )
     M = num_microbatches
 
     def local_fn(layers, h, cos, sin, mask, dtypes=None):
         # manual over pipeline only: h/cos/sin/mask are GLOBAL here (their
-        # data/tensor shardings are still handled by GSPMD in auto mode)
+        # data/tensor shardings are still handled by GSPMD in auto mode).
+        # ``layers`` leaves arrive as [v, 1, L/(v*P), ...]: chunk-major with
+        # the pipeline dim sharded away — squeeze it.
+        layers = jax.tree.map(lambda l: l.reshape((l.shape[0],) + l.shape[2:]), layers)
         idx = jax.lax.axis_index(MESH_AXIS_PIPELINE)
 
         def to_varying(x):
@@ -73,15 +149,15 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         if dtypes is not None:
             h, cos, sin = (to_varying(x).astype(d) for x, d in zip((h, cos, sin), dtypes))
 
-        def stage(h_mb, mask_mb):
+        def chunk_compute(chunk_layers, h_mb, mask_mb):
             def body(hh, lp):
                 hh, _ = decoder_layer(cfg, hh, lp, cos, sin, mask_mb, causal=True, dot_fn=dot_fn)
                 return hh, None
 
-            out, _ = jax.lax.scan(body, h_mb, layers)
+            out, _ = jax.lax.scan(body, h_mb, chunk_layers)
             return out
 
-        stage = jax.checkpoint(stage)
+        chunk_compute = jax.checkpoint(chunk_compute)
 
         b = h.shape[0]
         # adapt the microbatch count to the actual (static) batch: the default
@@ -89,14 +165,16 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         M_eff = min(M, b)
         while b % M_eff:
             M_eff -= 1
+        chunk_tab, use_tab, inj_tab, emit_tab, idle = build_interleaved_schedule(
+            nstages, v, M_eff
+        )
         if M_eff < M:  # trace-time: fires once per compiled shape
             from ..logging import get_logger
 
             get_logger(__name__).warning(
                 f"pipeline: num_microbatches={M} cut to {M_eff} by batch {b} — "
-                f"bubble fraction is {(nstages - 1) / (M_eff + nstages - 1):.0%}. "
-                "Raise the batch (or pick one divisible by the microbatch "
-                "count) to shrink it."
+                f"schedule idle fraction is {idle:.0%}. Raise the batch (or "
+                "pick one divisible by the microbatch count) to shrink it."
             )
         mb = h.reshape(M_eff, b // M_eff, *h.shape[1:])
         if mask is None:
@@ -108,35 +186,42 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         state = to_varying(jnp.zeros_like(mb[0]))
         state_mask = to_varying(jnp.ones_like(mask_mb_all[0]))
         outputs = to_varying(jnp.zeros_like(mb))
-        fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
+        ring = [(i, (i + 1) % nstages) for i in range(nstages)]
+        chunk_arr, use_arr = jnp.asarray(chunk_tab), jnp.asarray(use_tab)
+        inj_arr, emit_arr = jnp.asarray(inj_tab), jnp.asarray(emit_tab)
 
         def tick(carry, t):
             state, state_mask, outputs = carry
-            inject = jax.lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M_eff - 1), keepdims=False)
-            inject_mask = jax.lax.dynamic_index_in_dim(
-                mask_mb_all, jnp.clip(t, 0, M_eff - 1), keepdims=False
+            use = use_arr[idx, t].astype(bool)
+            inj = jnp.clip(inj_arr[idx, t], 0, M_eff - 1)
+            inject = jax.lax.dynamic_index_in_dim(mb, inj, keepdims=False)
+            inject_mask = jax.lax.dynamic_index_in_dim(mask_mb_all, inj, keepdims=False)
+            x = jnp.where(use, state, inject)
+            m = jnp.where(use, state_mask, inject_mask)
+            c = jnp.clip(chunk_arr[idx, t], 0, v - 1)
+            chunk_layers = jax.tree.map(
+                lambda l: jax.lax.dynamic_index_in_dim(l, c, keepdims=False), layers
             )
-            x = jnp.where(idx == 0, inject, state)
-            m = jnp.where(idx == 0, inject_mask, state_mask)
-            y = stage(x, m)
-            out_t = t - (nstages - 1)
+            y = chunk_compute(chunk_layers, x, m)
+            e = emit_arr[idx, t]
             collected = jax.lax.dynamic_update_slice(
-                outputs, y[None].astype(outputs.dtype), (jnp.clip(out_t, 0, M_eff - 1),) + (0,) * y.ndim
+                outputs, y[None].astype(outputs.dtype),
+                (jnp.clip(e, 0, M_eff - 1),) + (0,) * y.ndim,
             )
-            valid = (out_t >= 0) & (idx == nstages - 1)
-            outputs = jnp.where(valid, collected, outputs)
+            outputs = jnp.where(e >= 0, collected, outputs)
             if nstages > 1:
                 # the mask travels with its activation through the pipeline
-                state = jax.lax.ppermute(y, MESH_AXIS_PIPELINE, fwd_perm)
-                state_mask = jax.lax.ppermute(m, MESH_AXIS_PIPELINE, fwd_perm)
+                state = jax.lax.ppermute(y, MESH_AXIS_PIPELINE, ring)
+                state_mask = jax.lax.ppermute(m, MESH_AXIS_PIPELINE, ring)
             else:
                 state, state_mask = y, m
             return (state, state_mask, outputs), None
 
-        ticks = jnp.arange(M_eff + nstages - 1)
+        ticks = jnp.arange(chunk_arr.shape[1])
         (_, _, outputs), _ = jax.lax.scan(tick, (state, state_mask, outputs), ticks)
-        # fan the last stage's collected outputs out to every stage; the psum is
-        # exact because every other stage contributes zeros. Promote bf16/fp16 to
+        # fan the last virtual stage's collected outputs out to every stage
+        # (only device (v*P-1) mod P == P-1 ever emits); the psum is exact
+        # because every other stage contributes zeros. Promote bf16/fp16 to
         # fp32 around the collective: XLA's AllReducePromotion pass crashes on a
         # low-precision all-reduce emitted from a manual shard_map region
         # ("Invalid binary instruction opcode copy"), and fp32<->bf16 round-trip
@@ -166,9 +251,16 @@ def make_pipeline_layers_fn(cfg, mesh: Mesh, num_microbatches: int, dot_fn=None)
         def body(l, hh, c, s, m):
             return local_fn(l, hh, c, s, m, dtypes=dtypes)
 
+        # Rearrange stacked layers [L, ...] → [v, P, L/(v*P), ...]: virtual
+        # stage s = c*P + p lands at [c, p], so sharding dim 1 over the
+        # pipeline axis gives device p its v interleaved chunks.
+        chunk = cfg.num_layers // (v * nstages)
+        stacked_layers = jax.tree.map(
+            lambda l: l.reshape(v, nstages, chunk, *l.shape[1:]), stacked_layers
+        )
         # only the pipeline placement is manual; every other dim/axis is left
         # to GSPMD (tensor/fsdp shardings keep working inside the stage)
-        layers_specs = jax.tree.map(lambda _: P(MESH_AXIS_PIPELINE), stacked_layers)
+        layers_specs = jax.tree.map(lambda _: P(None, MESH_AXIS_PIPELINE), stacked_layers)
         other_specs = (P(), P(), P()) if mask is None else (P(), P(), P(), P())
         args = (stacked_layers,) + wide if mask is None else (stacked_layers,) + wide + (mask,)
         wrapped = (lambda l, hh, c, s: body(l, hh, c, s, None)) if mask is None else body

@@ -333,6 +333,10 @@ class ModelParallelPlugin:
     # Extra (regex, PartitionSpec-tuple) rules prepended to the model's own.
     partition_rules: Optional[list[tuple[str, tuple]]] = None
     num_microbatches: int = 1  # pipeline microbatching
+    # Megatron interleaved schedule (reference dataclasses.py:1246
+    # num_layers_per_virtual_pipeline_stage): chunks per device; shrinks the
+    # pipeline bubble ~v-fold at the same microbatch count
+    virtual_pipeline_stages: int = 1
     recompute_activations: bool = False
 
     @classmethod
@@ -343,6 +347,7 @@ class ModelParallelPlugin:
             pipeline_size=parse_int_from_env("ACCELERATE_PIPELINE_SIZE", 1),
             expert_size=parse_int_from_env("ACCELERATE_EXPERT_SIZE", 1),
             num_microbatches=parse_int_from_env("ACCELERATE_NUM_MICROBATCHES", 1),
+            virtual_pipeline_stages=parse_int_from_env("ACCELERATE_VIRTUAL_PIPELINE_STAGES", 1),
             recompute_activations=parse_flag_from_env("ACCELERATE_RECOMPUTE_ACTIVATIONS", False),
         )
 

@@ -18,7 +18,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from accelerate_tpu import PartialState
+from accelerate_tpu import PartialState, ops
 from accelerate_tpu.models import Llama, generate
 
 
@@ -32,17 +32,21 @@ def main(argv=None):
     model = Llama(args.model)
     params = model.init(jax.random.key(0))
 
-    # five prompts over N processes: uneven split, padded for the gather
+    # five prompts over N processes: uneven split, padded so every process
+    # contributes the same number of rounds to the gather below
     prompts = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [10, 11, 12], [13, 14, 15]]
-    outputs = []
+    local = []
     with state.split_between_processes(prompts, apply_padding=True) as shard:
         for prompt in shard:
             ids = jnp.asarray([prompt], jnp.int32)
             out = generate(model, params, ids, max_new_tokens=args.max_new_tokens)
-            outputs.append(np.asarray(out)[0].tolist())
+            local.append(np.asarray(out)[0].tolist())
 
-    state.print(f"process {state.process_index} generated {len(outputs)} sequences")
-    for seq in outputs[: len(prompts)]:
+    # host-level gather; the padded duplicates land at the tail, so slicing
+    # to len(prompts) recovers exactly one generation per prompt
+    outputs = ops.gather_object(local)[: len(prompts)]
+    state.print(f"{state.num_processes} process(es) generated {len(outputs)} sequences:")
+    for seq in outputs:
         state.print(f"  {seq}")
 
 

@@ -161,4 +161,6 @@ def test_big_model_inference_example(tmp_path):
 
 def test_distributed_inference_example():
     out = run_example("inference/distributed_inference.py", "--max_new_tokens", "4")
-    assert re.search(r"process 0 generated \d+ sequences", out)
+    assert re.search(r"process\(es\) generated 5 sequences", out)
+    # one generation per prompt, each echoing its prompt prefix
+    assert out.count("[1, 2, 3,") == 1 and out.count("[13, 14, 15,") == 1
