@@ -40,7 +40,7 @@ import jax.numpy as jnp
 
 from .data_loader import BaseDataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
-from .optimizer import AcceleratedOptimizer, clip_by_global_norm, scaled_optimizer_update
+from .optimizer import AcceleratedOptimizer, clip_by_global_norm, clip_by_value, scaled_optimizer_update
 from .ops import operations as ops
 from .parallel.sharding import PartitionRules, infer_shardings, replicated, shard_tree
 from .scheduler import AcceleratedScheduler
@@ -63,6 +63,9 @@ from .utils.environment import parse_int_from_env
 from .utils.random import next_rng_key, set_seed
 
 logger = get_logger(__name__)
+
+# distinguishes "argument omitted" from an explicit None (= clear the setting)
+_UNSET = object()
 
 
 class ParamBox:
@@ -657,28 +660,39 @@ class Accelerator:
             return loss / scale, aux
         return value / scale
 
-    def clip_grad_norm_(self, model_or_max_norm=None, max_norm: Optional[float] = None, norm_type: int = 2):
-        """Register gradient clipping for the next optimizer step.
+    def clip_grad_norm_(self, model_or_max_norm=_UNSET, max_norm=_UNSET, norm_type: int = 2):
+        """Register gradient clipping for subsequent optimizer steps.
 
         Signature accepts (parameters, max_norm) reference-style or just
         (max_norm). Clipping happens inside the jitted update using the
         *accumulated* gradient — identical semantics to clipping after
-        unscale (reference accelerator.py:2131-2180).
+        unscale (reference accelerator.py:2131-2180). The setting is sticky
+        (applies to every later step); pass an explicit ``None`` to clear it.
         """
         if norm_type != 2:
             raise ValueError("Only the L2 grad norm is supported under XLA.")
-        if max_norm is None:
+        if max_norm is _UNSET:
             max_norm = model_or_max_norm
-        if max_norm is None:
+        if max_norm is _UNSET:
             raise ValueError("clip_grad_norm_ needs max_norm")
         for optimizer in self._optimizers:
-            optimizer.set_clip_grad_norm(float(max_norm))
+            optimizer.set_clip_grad_norm(None if max_norm is None else float(max_norm))
 
-    def clip_grad_value_(self, *args, **kwargs):
-        raise NotImplementedError(
-            "clip_grad_value_ is not implemented; use clip_grad_norm_ (value clipping "
-            "breaks gradient direction and is rarely what you want at scale)."
-        )
+    def clip_grad_value_(self, model_or_clip_value=_UNSET, clip_value=_UNSET):
+        """Register elementwise gradient clamping to [-clip_value, clip_value]
+        (reference accelerator.py:2183, torch.nn.utils.clip_grad_value_
+        semantics). Accepts (parameters, clip_value) reference-style or just
+        (clip_value). Applied inside the jitted update on the accumulated,
+        unscaled gradient, before any clip_grad_norm_. The setting is sticky
+        (applies to every later step); pass an explicit ``None`` to clear it.
+        Prefer clip_grad_norm_ at scale — value clipping changes the gradient
+        direction."""
+        if clip_value is _UNSET:
+            clip_value = model_or_clip_value
+        if clip_value is _UNSET:
+            raise ValueError("clip_grad_value_ needs clip_value")
+        for optimizer in self._optimizers:
+            optimizer.set_clip_grad_value(None if clip_value is None else float(clip_value))
 
     def _do_sync(self) -> None:
         if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
@@ -748,7 +762,13 @@ class Accelerator:
     # fused fast path
     # ------------------------------------------------------------------
 
-    def compiled_step(self, loss_fn: Callable, model: Optional[PreparedModel] = None, clip_grad_norm: Optional[float] = None):
+    def compiled_step(
+        self,
+        loss_fn: Callable,
+        model: Optional[PreparedModel] = None,
+        clip_grad_norm: Optional[float] = None,
+        clip_grad_value: Optional[float] = None,
+    ):
         """One fused jit program: grads (+ scan over microbatches) → clip → update.
 
         Returns ``step(batch) -> loss``. The batch's leading dim is split into
@@ -792,6 +812,7 @@ class Accelerator:
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, batch, scale)
             grads = jax.tree.map(lambda g: g / scale, grads)
+            grads = clip_by_value(grads, clip_grad_value)
             grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
 
             # unscale the reported loss with the scale it was computed under,

@@ -2,11 +2,12 @@ from .attention import dot_product_attention, rotary_embedding
 from .bert import Bert
 from .config import TransformerConfig, get_config, list_models, param_count, register_config
 from .generation import generate
+from .gpt2 import GPT2
 from .llama import Llama
 from .moe import MoEBlock
 
 
-_ARCHS = {"llama": Llama, "bert": Bert}
+_ARCHS = {"llama": Llama, "bert": Bert, "gpt2": GPT2}
 
 
 def build_model(name: str):

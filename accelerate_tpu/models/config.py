@@ -82,6 +82,28 @@ _REGISTRY: dict[str, TransformerConfig] = {
         num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
         num_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
     ),
+    # gpt2 family (decoder, learned positions + LayerNorm + tied embeddings) —
+    # the reference's big-model benchmark lineage (GPT-J/NeoX, README.md:31-34)
+    "gpt2-tiny": TransformerConfig(
+        arch="gpt2", vocab_size=1024, hidden_size=128, intermediate_size=512,
+        num_layers=2, num_heads=4, max_seq_len=256, tie_embeddings=True,
+    ),
+    "gpt2-124m": TransformerConfig(
+        arch="gpt2", vocab_size=50257, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024, tie_embeddings=True,
+    ),
+    "gpt2-355m": TransformerConfig(
+        arch="gpt2", vocab_size=50257, hidden_size=1024, intermediate_size=4096,
+        num_layers=24, num_heads=16, max_seq_len=1024, tie_embeddings=True,
+    ),
+    "gpt2-774m": TransformerConfig(
+        arch="gpt2", vocab_size=50257, hidden_size=1280, intermediate_size=5120,
+        num_layers=36, num_heads=20, max_seq_len=1024, tie_embeddings=True,
+    ),
+    "gpt2-1.5b": TransformerConfig(
+        arch="gpt2", vocab_size=50257, hidden_size=1600, intermediate_size=6400,
+        num_layers=48, num_heads=25, max_seq_len=1024, tie_embeddings=True,
+    ),
     # bert family (encoder) — nlp_example parity (BERT-base MRPC)
     "bert-tiny": TransformerConfig(
         arch="bert", vocab_size=1024, hidden_size=128, intermediate_size=512,
@@ -132,6 +154,16 @@ def param_count(config: TransformerConfig) -> int:
         if not config.tie_embeddings:
             total += h * v  # lm head
         return total
+    if config.arch == "gpt2":
+        embed = v * h + config.max_seq_len * h  # token + learned positions (tied head)
+        per_layer = (
+            h * 3 * h + 3 * h     # fused qkv with bias
+            + h * h + h           # o with bias
+            + h * i + i           # mlp up
+            + i * h + h           # mlp down
+            + 4 * h               # two layernorms (scale+bias)
+        )
+        return embed + config.num_layers * per_layer + 2 * h  # + final layernorm
     if config.arch == "bert":
         embed = v * h + config.max_seq_len * h + config.type_vocab_size * h + 2 * h
         per_layer = (

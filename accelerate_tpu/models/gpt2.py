@@ -1,0 +1,204 @@
+"""GPT-2-family causal LM, TPU-first.
+
+Capability parity: the reference's big-model benchmark and inference examples
+exercise GPT-2-lineage checkpoints (GPT-J/GPT-NeoX in benchmarks/README.md:
+31-34, examples/inference/pippy/gpt2.py). Architecturally distinct from the
+llama family: learned absolute position embeddings (no RoPE), LayerNorm with
+bias (no RMSNorm), a plain GELU MLP (no gating), biases on every projection,
+and tied input/output embeddings.
+
+Same TPU-first design as models/llama.py: stacked layers on a leading L axis
+run as one ``lax.scan``; megatron-style TP partition rules; activation
+sharding constraints; fp32 norm/softmax accumulation under bf16. Implements
+the stream protocol (stream_prefix/stream_layer/stream_suffix) so
+``dispatch_model`` offloads it like any other model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.constants import MESH_AXIS_SEQUENCE, MESH_AXIS_TENSOR
+from .attention import dense_init, dot_product_attention, dropout, resolve_dot
+from .bert import layer_norm
+from .config import TransformerConfig, get_config
+from .llama import BATCH_AXES, _constrain
+
+
+class GPT2:
+    """(init, apply) pair for a GPT-2-style causal LM (tied embeddings)."""
+
+    def __init__(self, config: TransformerConfig | str):
+        self.config = get_config(config) if isinstance(config, str) else config
+        assert self.config.arch == "gpt2"
+        # hooks set by Accelerator.prepare_model (see models/llama.py)
+        self.remat_layers = False
+        self.dot_fn = None
+
+    # -- parameters --------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        if not hasattr(self, "_init_jit"):
+            self._init_jit = jax.jit(self._init)
+        return self._init_jit(rng)
+
+    def _init(self, rng: jax.Array) -> dict:
+        cfg = self.config
+        h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+        keys = iter(jax.random.split(rng, 12))
+        dense = dense_init
+        return {
+            "embed_tokens": jax.random.normal(next(keys), (v, h), jnp.float32) * 0.02,
+            "embed_positions": jax.random.normal(next(keys), (cfg.max_seq_len, h), jnp.float32) * 0.01,
+            "layers": {
+                "attn_norm_scale": jnp.ones((L, h), jnp.float32),
+                "attn_norm_bias": jnp.zeros((L, h), jnp.float32),
+                "wqkv": dense(next(keys), (L, h, 3 * h), h),
+                "bqkv": jnp.zeros((L, 3 * h), jnp.float32),
+                "wo": dense(next(keys), (L, h, h), h),
+                "bo": jnp.zeros((L, h), jnp.float32),
+                "mlp_norm_scale": jnp.ones((L, h), jnp.float32),
+                "mlp_norm_bias": jnp.zeros((L, h), jnp.float32),
+                "w_up": dense(next(keys), (L, h, i), h),
+                "b_up": jnp.zeros((L, i), jnp.float32),
+                "w_down": dense(next(keys), (L, i, h), i),
+                "b_down": jnp.zeros((L, h), jnp.float32),
+            },
+            "final_norm_scale": jnp.ones((h,), jnp.float32),
+            "final_norm_bias": jnp.zeros((h,), jnp.float32),
+        }
+
+    # -- sharding ----------------------------------------------------------
+
+    def partition_rules(self) -> list[tuple[str, tuple]]:
+        """TP: fused qkv and MLP-up column-parallel, output projections
+        row-parallel; stacked leading dim is the scan axis (pipeline rule)."""
+        from ..utils.constants import MESH_AXIS_PIPELINE
+
+        t = MESH_AXIS_TENSOR
+        p = MESH_AXIS_PIPELINE
+        return [
+            (r"embed_tokens", (t, None)),
+            (r"embed_positions", (None, None)),
+            (r"layers/wqkv", (p, None, t)),
+            (r"layers/bqkv", (p, t)),
+            (r"layers/wo", (p, t, None)),
+            (r"layers/w_up", (p, None, t)),
+            (r"layers/b_up", (p, t)),
+            (r"layers/w_down", (p, t, None)),
+            (r"layers/(attn_norm|mlp_norm|bo|b_down)", (p, None)),
+            (r"final_norm", (None,)),
+        ]
+
+    # -- one transformer block (shared by apply and the stream protocol) ----
+
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None)) -> jax.Array:
+        cfg = self.config
+        dot = resolve_dot(self.dot_fn)
+        b, s, _ = h.shape
+        nh = cfg.num_heads
+        d = cfg.hidden_size // nh
+        x = layer_norm(h, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+        qkv = dot(x, lp["wqkv"]) + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(b, s, nh, d) for t in (q, k, v))
+        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+        attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
+        if rngs[0] is not None:
+            attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
+        h = h + attn_out
+        x = layer_norm(h, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
+        mlp_out = dot(jax.nn.gelu(dot(x, lp["w_up"]) + lp["b_up"]), lp["w_down"]) + lp["b_down"]
+        if rngs[1] is not None:
+            mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
+        return h + mlp_out
+
+    # -- forward -----------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict,
+        input_ids: jax.Array,  # [B, S] int32
+        attention_mask: Optional[jax.Array] = None,  # [B, S] 1=real
+        positions: Optional[jax.Array] = None,
+        dropout_rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Logits [B, S, V] (LM head = tied token embedding)."""
+        cfg = self.config
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        h = jnp.take(params["embed_tokens"], input_ids, axis=0) + jnp.take(
+            params["embed_positions"], positions, axis=0
+        )
+        h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        use_dropout = dropout_rng is not None and cfg.dropout_rate > 0.0
+        if use_dropout:
+            layer_rngs = jax.random.split(dropout_rng, cfg.num_layers * 2).reshape(cfg.num_layers, 2)
+
+        def layer(h, xs):
+            lp = xs[0] if use_dropout else xs
+            rngs = tuple(xs[1]) if use_dropout else (None, None)
+            h = self._block(h, lp, mask, rngs)
+            return _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None), None
+
+        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+        body = (
+            jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+            if self.remat_layers
+            else layer
+        )
+        h, _ = jax.lax.scan(body, h, xs)
+        h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
+        return (h @ params["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
+
+    # -- streaming protocol (big-model dispatch, big_modeling.StreamedModel) --
+
+    def stream_prefix(self, resident, input_ids, attention_mask=None):
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        h = jnp.take(resident["embed_tokens"], input_ids, axis=0) + jnp.take(
+            resident["embed_positions"], jnp.arange(s)[None, :], axis=0
+        )
+        mask = None
+        if attention_mask is not None:
+            mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
+        return (h, mask)
+
+    def stream_layer(self, carry, lp):
+        h, mask = carry
+        return (self._block(h, lp, mask), mask)
+
+    def stream_suffix(self, resident, carry):
+        h, _ = carry
+        cfg = self.config
+        h = layer_norm(h, resident["final_norm_scale"], resident["final_norm_bias"], cfg.norm_eps)
+        return (h @ resident["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
+
+    # -- loss --------------------------------------------------------------
+
+    @staticmethod
+    def loss_fn(model: "GPT2"):
+        """Next-token CE over {input_ids, attention_mask?}."""
+
+        def fn(params, batch):
+            logits = model.apply(
+                params, batch["input_ids"], batch.get("attention_mask")
+            ).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            tgt = batch["input_ids"][:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).squeeze(-1)
+            mask = batch.get("attention_mask")
+            if mask is not None:
+                valid = mask[:, 1:].astype(nll.dtype)
+                return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+            return nll.mean()
+
+        return fn

@@ -80,6 +80,14 @@ def clip_by_global_norm(grads, clip_norm):
     return grads, gnorm
 
 
+def clip_by_value(grads, clip_value):
+    """Elementwise clamp to [-clip_value, clip_value] (reference
+    torch.nn.utils.clip_grad_value_ semantics); identity when None."""
+    if clip_value is None:
+        return grads
+    return jax.tree.map(lambda g: jnp.clip(g, -clip_value, clip_value), grads)
+
+
 class AcceleratedOptimizer:
     def __init__(
         self,
@@ -142,8 +150,9 @@ class AcceleratedOptimizer:
             self.growth_tracker = None
 
         self._add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b), donate_argnums=(0,))
-        self._update_fn = None  # built lazily per clip-norm setting
+        self._update_fn = None  # built lazily per clip setting
         self._pending_clip_norm = clip_grad_norm
+        self._pending_clip_value = None
 
     # -- gradient intake (called by Accelerator.backward) -------------------
 
@@ -168,16 +177,23 @@ class AcceleratedOptimizer:
             self._pending_clip_norm = max_norm
             self._update_fn = None  # different constant → recompile
 
+    def set_clip_grad_value(self, clip_value: Optional[float]) -> None:
+        if clip_value != self._pending_clip_value:
+            self._pending_clip_value = clip_value
+            self._update_fn = None  # different constant → recompile
+
     # -- the update --------------------------------------------------------
 
     def _build_update_fn(self):
         clip_norm = self._pending_clip_norm
+        clip_value = self._pending_clip_value
         use_scaler = self.scaler is not None
         scaler_cfg = self.scaler
 
         def update(params, opt_state, grads, accum_count, scale, growth_tracker):
             denom = accum_count.astype(jnp.float32) * (scale if use_scaler else jnp.float32(1.0))
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
+            grads = clip_by_value(grads, clip_value)
             grads, gnorm = clip_by_global_norm(grads, clip_norm)
             params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
                 self.tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg

@@ -186,6 +186,156 @@ def check_gather_for_metrics(accelerator):
     assert set(flat.astype(int).tolist()) == set(range(n))
 
 
+def check_ops_coverage(state):
+    """broadcast / broadcast_object_list / pad_across_processes / gather_object
+    / reduce mean (reference test_utils/scripts/test_ops.py)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu import ops
+
+    src = {"t": np.full((4,), float(state.process_index), np.float32)}
+    b = ops.broadcast(ops.send_to_device(src))
+    assert np.allclose(np.asarray(b["t"]), 0.0), "broadcast did not take rank 0's value"
+
+    objs = [f"rank-{state.process_index}", state.process_index]
+    synced = ops.broadcast_object_list(list(objs))
+    assert synced == ["rank-0", 0], f"broadcast_object_list: {synced}"
+
+    ragged = jnp.arange(3 + state.process_index, dtype=jnp.float32)
+    padded = ops.pad_across_processes(ragged, pad_index=-1.0)
+    assert padded.shape[0] == 3 + state.num_processes - 1, padded.shape
+
+    gathered = ops.gather_object([state.process_index])
+    assert gathered == list(range(state.num_processes)), gathered
+
+    mean = ops.reduce({"v": np.full(3, float(state.process_index + 1))}, "mean")
+    expected = np.mean([p + 1 for p in range(state.num_processes)])
+    assert np.allclose(mean["v"], expected), "reduce mean failed"
+
+
+def check_uneven_end_of_epoch(accelerator):
+    """End-of-epoch remainder behavior: even_batches pads by cycling from the
+    start; the loader still reports the true dataset length (reference
+    test_utils/scripts/test_distributed_data_loop.py)."""
+    n = accelerator.num_processes * 8 + 5
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    loader = accelerator.prepare_data_loader(DS(), batch_size=4)
+    seen = [np.asarray(accelerator.gather(batch["x"])) for batch in loader]
+    flat = np.concatenate(seen)
+    assert loader.total_dataset_length == n
+    # padded total: every rank contributed the same number of equal batches
+    assert len(flat) % accelerator.num_processes == 0
+    # every real sample appears at least once
+    assert set(range(n)) <= set(flat.astype(int).tolist())
+
+
+def check_checkpoint_resume(accelerator_factory):
+    """save_state mid-training → load_state → identical continuation
+    (reference external_deps/test_checkpointing.py)."""
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(64,)).astype(np.float32)
+    y = (3 * x - 1).astype(np.float32)
+
+    def batches():
+        return [
+            {"x": jnp.asarray(x[s : s + 8]), "y": jnp.asarray(y[s : s + 8])} for s in range(0, 64, 8)
+        ]
+
+    acc = accelerator_factory(1)
+    model, opt = acc.prepare(_LinearModel(), optax.adam(0.05))
+    for batch in batches()[:4]:
+        acc.backward(_linear_loss, batch)
+        opt.step()
+        opt.zero_grad()
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "ckpt")
+        acc.save_state(ckpt)
+        for batch in batches()[4:]:
+            acc.backward(_linear_loss, batch)
+            opt.step()
+            opt.zero_grad()
+        final_direct = jax.device_get(model.params)
+
+        acc2 = accelerator_factory(1)
+        model2, opt2 = acc2.prepare(_LinearModel(), optax.adam(0.05))
+        acc2.load_state(ckpt)
+        for batch in batches()[4:]:
+            acc2.backward(_linear_loss, batch)
+            opt2.step()
+            opt2.zero_grad()
+        final_resumed = jax.device_get(model2.params)
+    for key in final_direct:
+        np.testing.assert_allclose(
+            np.asarray(final_direct[key]), np.asarray(final_resumed[key]), rtol=1e-5,
+            err_msg=f"checkpoint resume diverged on {key}",
+        )
+
+
+def check_skip_first_batches(accelerator):
+    """skip_first_batches(k) yields exactly the loader's batches k..end."""
+    n = accelerator.num_processes * 16
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    loader = accelerator.prepare_data_loader(DS(), batch_size=4)
+    all_batches = [np.asarray(b["x"]) for b in loader]
+    skipped = accelerator.skip_first_batches(loader, 2)
+    rest = [np.asarray(b["x"]) for b in skipped]
+    assert len(rest) == len(all_batches) - 2
+    for a, b in zip(all_batches[2:], rest):
+        np.testing.assert_array_equal(a, b)
+
+
+def check_sync_gradients_flag(accelerator_factory):
+    """sync_gradients toggles on the accumulation boundary and the scheduler
+    only advances on real steps (reference test_utils/scripts/test_sync.py)."""
+    import optax
+
+    import jax.numpy as jnp
+
+    acc = accelerator_factory(2)
+    model, opt, sched = acc.prepare(_LinearModel(), optax.sgd(0.01), lambda count: 0.01)
+    flags = []
+    for i in range(4):
+        with acc.accumulate(model):
+            acc.backward(
+                _linear_loss, {"x": jnp.asarray([1.0 * i]), "y": jnp.asarray([2.0 * i])}
+            )
+            flags.append(bool(acc.sync_gradients))
+            opt.step()
+            sched.step()
+            opt.zero_grad()
+    assert flags == [False, True, False, True], flags
+    assert opt.step_count == 2, opt.step_count
+
+
+def check_trigger(accelerator):
+    """set_trigger/check_trigger: the all-reduced breakpoint flag
+    (reference test_script.py trigger checks / accelerator.py:2037)."""
+    assert not accelerator.check_trigger()
+    if accelerator.is_main_process:
+        accelerator.set_trigger()
+    assert accelerator.check_trigger()  # every rank sees main's flag
+    assert not accelerator.check_trigger()  # reading resets it
+
+
 def check_process_execution(state):
     """main_process_first ordering + on_main_process decorators + splitting
     (reference test_script.py:85-116 process_execution_check)."""
@@ -217,6 +367,7 @@ def main():
 
     state = PartialState()
     check_topology_and_ops(state)
+    check_ops_coverage(state)
     check_rng_determinism()
     check_dataloader_shard_exactness(state)
     check_process_execution(state)
@@ -237,6 +388,11 @@ def main():
     check_training_parity(fresh_accelerator())
     check_gradient_accumulation(fresh_accelerator)
     check_gather_for_metrics(fresh_accelerator())
+    check_uneven_end_of_epoch(fresh_accelerator())
+    check_checkpoint_resume(fresh_accelerator)
+    check_skip_first_batches(fresh_accelerator())
+    check_sync_gradients_flag(fresh_accelerator)
+    check_trigger(fresh_accelerator())
 
     PartialState().print("All distributed correctness checks passed.")
 

@@ -235,10 +235,11 @@ def bench_big_model_inference() -> dict:
         cfg = model.config
         device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
         device_map.update({f"layers.{i}": "cpu" for i in range(cfg.num_layers)})
-        # 64MB streaming window < total layer bytes: the run must actually
-        # stream (the memory invariant below would catch a resident cheat)
+        # 128MB streaming window < total layer bytes (170MB for llama-125m):
+        # the run must actually stream (the memory invariant below would catch
+        # a resident cheat)
         lm = load_checkpoint_and_dispatch(
-            model, d, device_map=device_map, dtype=jnp.bfloat16, stream_window_bytes=64 << 20
+            model, d, device_map=device_map, dtype=jnp.bfloat16, stream_window_bytes=128 << 20
         )
         load_s = time.perf_counter() - start
 
@@ -272,18 +273,46 @@ def bench_big_model_inference() -> dict:
     return result
 
 
+def _bench_big_model_subprocess() -> dict:
+    """Run the big-model bench in a FRESH process: the training benches above
+    fetch losses to the host, and on tunneled TPU transports the first
+    device→host fetch permanently degrades H2D DMA ~100x — which is exactly
+    the path the streaming benchmark measures. A clean process keeps the
+    measured run in the fast regime (its own decode loop is fetch-free)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["BENCH_ONLY"] = "bigmodel"
+    result = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        capture_output=True, text=True, timeout=1500, env=env,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(f"big-model sub-bench failed:\n{result.stdout}\n{result.stderr}")
+    return json.loads(result.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
+
+    if os.environ.get("BENCH_ONLY") == "bigmodel":
+        print(json.dumps(bench_big_model_inference()))
+        return
 
     extra: dict = {}
     errors: dict = {}
     primary = bench_bert_training()
     extra.update(primary)
-    for fn in (bench_llama_fsdp, bench_llama_longseq, bench_big_model_inference):
+    for fn in (bench_llama_fsdp, bench_llama_longseq):
         try:
             extra.update(fn())
         except Exception as e:  # a sub-bench must not take down the primary metric
             errors[fn.__name__] = f"{type(e).__name__}: {e}"
+    try:
+        extra.update(_bench_big_model_subprocess())
+    except Exception as e:
+        errors["bench_big_model_inference"] = f"{type(e).__name__}: {e}"
 
     value = primary["bert_train_steps_per_sec_per_chip"]
     device = jax.devices()[0]

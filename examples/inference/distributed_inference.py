@@ -2,8 +2,8 @@
 gather the generations (reference examples/inference/distributed_inference.py,
 which uses PartialState.split_between_processes).
 
-Each host generates only its slice; ``apply_padding`` keeps the collective
-shapes equal so the final gather works with uneven prompt counts.
+Each host generates only its slice; ``ops.gather_object`` reassembles the
+per-rank lists in rank order, so uneven prompt counts need no padding.
 
 Run (single host it degrades to a plain loop):
     python examples/inference/distributed_inference.py --max_new_tokens 8
@@ -32,19 +32,17 @@ def main(argv=None):
     model = Llama(args.model)
     params = model.init(jax.random.key(0))
 
-    # five prompts over N processes: uneven split, padded so every process
-    # contributes the same number of rounds to the gather below
+    # five prompts over N processes: uneven split is fine — gather_object is a
+    # host-level object gather, so ragged per-rank lists need no padding
     prompts = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [10, 11, 12], [13, 14, 15]]
     local = []
-    with state.split_between_processes(prompts, apply_padding=True) as shard:
+    with state.split_between_processes(prompts) as shard:
         for prompt in shard:
             ids = jnp.asarray([prompt], jnp.int32)
             out = generate(model, params, ids, max_new_tokens=args.max_new_tokens)
             local.append(np.asarray(out)[0].tolist())
 
-    # host-level gather; the padded duplicates land at the tail, so slicing
-    # to len(prompts) recovers exactly one generation per prompt
-    outputs = ops.gather_object(local)[: len(prompts)]
+    outputs = ops.gather_object(local)
     state.print(f"{state.num_processes} process(es) generated {len(outputs)} sequences:")
     for seq in outputs:
         state.print(f"  {seq}")

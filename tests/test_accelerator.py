@@ -160,6 +160,31 @@ def test_clip_grad_norm():
     assert delta <= 0.01 * 0.001 * 2 + 1e-9
 
 
+def test_clip_grad_value():
+    accelerator = Accelerator()
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.01), _make_data())
+    batch = next(iter(loader))
+    accelerator.backward(loss_fn, batch)
+    accelerator.clip_grad_value_(model, 0.002)
+    before = jax.device_get(model.params)
+    optimizer.step()
+    after = jax.device_get(model.params)
+    # each parameter's update magnitude bounded by lr * clip_value
+    assert abs(float(after["a"]) - float(before["a"])) <= 0.01 * 0.002 + 1e-9
+    assert abs(float(after["b"]) - float(before["b"])) <= 0.01 * 0.002 + 1e-9
+
+
+def test_clip_grad_value_compiled_step():
+    accelerator = Accelerator()
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.01), _make_data())
+    step = accelerator.compiled_step(loss_fn, clip_grad_value=0.002)
+    before = jax.device_get(model.params)
+    step(next(iter(loader)))
+    after = jax.device_get(model.params)
+    assert abs(float(after["a"]) - float(before["a"])) <= 0.01 * 0.002 + 1e-9
+    assert abs(float(after["b"]) - float(before["b"])) <= 0.01 * 0.002 + 1e-9
+
+
 def test_fp16_loss_scaling_runs():
     accelerator = Accelerator(mixed_precision="fp16")
     model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.05), _make_data())
@@ -408,3 +433,21 @@ def test_checkpoint_npz_fallback_roundtrip(tmp_path, monkeypatch):
     assert not os.path.exists(target)
     loaded = ck._load_flat(target)  # resolves the .npz sibling
     np.testing.assert_array_equal(loaded["w"], flat["w"])
+
+
+def test_clip_settings_clearable():
+    """Clipping registrations are sticky; explicit None clears them."""
+    accelerator = Accelerator()
+    model, optimizer, loader = accelerator.prepare(LinearModel(), optax.sgd(0.5), _make_data())
+    accelerator.clip_grad_value_(1e-6)
+    accelerator.clip_grad_norm_(1e-6)
+    accelerator.clip_grad_value_(None)
+    accelerator.clip_grad_norm_(None)
+    batch = next(iter(loader))
+    accelerator.backward(loss_fn, batch)
+    before = jax.device_get(model.params)
+    optimizer.step()
+    after = jax.device_get(model.params)
+    # with both clips cleared the update is NOT bounded by lr * 1e-6
+    delta = abs(float(after["a"]) - float(before["a"]))
+    assert delta > 0.5 * 1e-6 * 10

@@ -106,3 +106,104 @@ def test_build_model_registry():
     assert isinstance(build_model("bert-base"), Bert)
     with pytest.raises(KeyError):
         build_model("gpt-unknown")
+
+
+# -- gpt2 family --------------------------------------------------------------
+
+
+def test_gpt2_forward_shape_and_param_count():
+    from accelerate_tpu.models import GPT2
+    from accelerate_tpu.models.config import get_config, param_count
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(0))
+    counted = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert counted == param_count(get_config("gpt2-tiny"))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (2, 12)), jnp.int32)
+    logits = model.apply(params, ids)
+    assert logits.shape == (2, 12, 1024)
+    assert logits.dtype == jnp.float32
+
+
+def test_gpt2_causality():
+    """Changing a future token must not change past logits."""
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(1))
+    ids = np.random.default_rng(1).integers(0, 1024, (1, 10)).astype(np.int32)
+    base = np.asarray(model.apply(params, jnp.asarray(ids)))
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 7) % 1024
+    changed = np.asarray(model.apply(params, jnp.asarray(ids2)))
+    np.testing.assert_allclose(base[0, :-1], changed[0, :-1], atol=1e-5)
+    assert not np.allclose(base[0, -1], changed[0, -1])
+
+
+def test_gpt2_tp_forward_matches_single_device():
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(2))
+    ids = jnp.asarray(np.random.default_rng(2).integers(0, 1024, (4, 16)), jnp.int32)
+    expected = model.apply(params, ids)
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(tensor=4))
+    prepared = accelerator.prepare_model(model, params=params)
+    got = prepared(ids)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_gpt2_trains():
+    from accelerate_tpu.models import GPT2
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(fsdp=2, tensor=2))
+    model = GPT2("gpt2-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    loss_fn = GPT2.loss_fn(model)
+    batch = {"input_ids": jnp.asarray(np.random.default_rng(3).integers(0, 1024, (8, 32)), jnp.int32)}
+    losses = []
+    for _ in range(10):
+        with accelerator.accumulate(prepared):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_masked_loss_ignores_padding():
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(4))
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 1024, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    mask[1, 8:] = 0
+    loss_fn = GPT2.loss_fn(model)
+    base = float(loss_fn(params, {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}))
+    ids2 = ids.copy()
+    ids2[1, 9:] = 0  # mutate only padded positions
+    got = float(loss_fn(params, {"input_ids": jnp.asarray(ids2), "attention_mask": jnp.asarray(mask)}))
+    np.testing.assert_allclose(base, got, rtol=1e-6)
+
+
+def test_gpt2_streamed_dispatch_matches_full():
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(5))
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, 1024, (2, 10)), jnp.int32)
+    full = model.apply(params, ids)
+    streamed = cpu_offload(model, params, dtype=jnp.float32)
+    got = streamed(ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full), atol=1e-4)
+
+
+def test_gpt2_in_registry():
+    from accelerate_tpu.models import GPT2
+
+    assert isinstance(build_model("gpt2-124m"), GPT2)
