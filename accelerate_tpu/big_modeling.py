@@ -37,9 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from .logging import get_logger
-from .models.attention import rotary_embedding
 from .models.config import TransformerConfig
-from .models.llama import Llama, decoder_layer, rms_norm
+from .models.llama import Llama
 from .utils.modeling import _iter_flat as _flat_items, check_device_map, infer_auto_device_map
 from .utils.offload import load_offloaded_weight, offload_weight, save_offload_index
 
@@ -289,215 +288,6 @@ class QuantizedLayerPacker:
         return _unflatten(out)
 
 
-class StreamedCausalLM(_LayerStreamer):
-    """A llama-family model whose layers may live on device, host RAM, or disk.
-
-    Adds the KV-cache ``generate`` decode loop on top of the shared streaming
-    base.
-    """
-
-    def __init__(
-        self,
-        model: Llama,
-        resident: dict[str, jax.Array],
-        layer_buffers: list[Any],
-        layer_on_device: list[bool],
-        packer: LayerPacker,
-        dtype=jnp.bfloat16,
-        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
-    ):
-        super().__init__(
-            model, layer_buffers, layer_on_device, packer, dtype,
-            stream_window_bytes=stream_window_bytes,
-        )
-        self.config: TransformerConfig = model.config
-        self.resident = resident
-        self._group_fns: dict = {}
-        self._cached_group_fns: dict = {}
-        self._prelude_fns: dict = {}
-        self._tail_fns: dict = {}
-
-    def _resident(self, key: str) -> jax.Array:
-        """Fetch a non-layer component, streaming it if device_map kept it on
-        host/disk (embed/head can legitimately spill on tight budgets)."""
-        value = self.resident[key]
-        if isinstance(value, jax.Array):
-            return value
-        return self._put(np.asarray(value))
-
-    def _get_group_fn(self, n: int):
-        """Jitted program applying ``n`` streamed layers (no KV cache).
-
-        One dispatch per group instead of per layer — remote TPU transports
-        pay tens of ms per program dispatch.
-        """
-        # keyed on dot_fn too: toggling fp8 on the model must recompile
-        dot_fn = getattr(self.model, "dot_fn", None)
-        key = (n,)
-        if key not in self._group_fns or self._group_fns[key][0] is not dot_fn:
-            cfg = self.config
-            unpack = self.packer.unpack
-
-            @jax.jit
-            def group_fn(h, bufs, cos, sin, mask):
-                for buf in bufs:
-                    h, _ = decoder_layer(cfg, h, unpack(buf), cos, sin, mask, causal=True, dot_fn=dot_fn)
-                return h
-
-            self._group_fns[key] = (dot_fn, group_fn)
-        return self._group_fns[key][1]
-
-    def __call__(self, input_ids, attention_mask: Optional[Any] = None) -> jax.Array:
-        """Full-sequence logits [B, S, V]."""
-        cfg = self.config
-        input_ids = jnp.asarray(input_ids, jnp.int32)
-        b, s = input_ids.shape
-        h = jnp.take(self._resident("embed_tokens"), input_ids, axis=0).astype(self.dtype)
-        positions = jnp.arange(s)[None, :]
-        cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
-        mask = None
-        if attention_mask is not None:
-            mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
-        for bufs in self._iter_device_layer_groups():
-            h = self._get_group_fn(len(bufs))(h, tuple(bufs), cos, sin, mask)
-        h = rms_norm(h, self._resident("final_norm"), cfg.norm_eps)
-        head = (
-            self._resident("embed_tokens").T
-            if cfg.tie_embeddings
-            else self._resident("lm_head")
-        )
-        return (h @ head.astype(h.dtype)).astype(jnp.float32)
-
-    def _get_cached_group_fn(self, n: int):
-        """Jitted program applying ``n`` streamed layers with KV caches."""
-        dot_fn = getattr(self.model, "dot_fn", None)
-        key = (n,)
-        if key not in self._cached_group_fns or self._cached_group_fns[key][0] is not dot_fn:
-            cfg = self.config
-            unpack = self.packer.unpack
-
-            @jax.jit
-            def fn(h, bufs, caches, length, cos, sin, mask):
-                new_caches = []
-                for buf, cache in zip(bufs, caches):
-                    h, nc = decoder_layer(
-                        cfg, h, unpack(buf), cos, sin, mask,
-                        cache={"k": cache["k"], "v": cache["v"], "length": length},
-                        dot_fn=dot_fn,
-                    )
-                    new_caches.append({"k": nc["k"], "v": nc["v"]})
-                return h, tuple(new_caches)
-
-            self._cached_group_fns[key] = (dot_fn, fn)
-        return self._cached_group_fns[key][1]
-
-    def _get_prelude_fn(self, max_len: int):
-        """Jitted per-token prelude: embed lookup + RoPE tables + KV mask.
-
-        One fused dispatch instead of ~10 eager ops — eager dispatch latency
-        through a remote TPU transport is tens of ms per op, which would
-        dominate the per-token budget.
-        """
-        if max_len not in self._prelude_fns:
-            cfg = self.config
-            dtype = self.dtype
-
-            @jax.jit
-            def prelude(embed, current, length):
-                blk = current.shape[1]
-                h = jnp.take(embed, current, axis=0).astype(dtype)
-                positions = length + jnp.arange(blk)[None, :]
-                cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
-                q_pos = length + jnp.arange(blk)
-                mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
-                return h, cos, sin, mask
-
-            self._prelude_fns[max_len] = prelude
-        return self._prelude_fns[max_len]
-
-    def _get_tail_fn(self, sampled: bool):
-        """Jitted per-token tail: final norm + LM head + next-token choice.
-
-        Also advances ``length`` and the PRNG key on device, so the decode
-        loop never materializes a host value (a single device→host fetch can
-        permanently degrade DMA on tunneled transports; see ``_np_dtype``).
-        """
-        if sampled not in self._tail_fns:
-            cfg = self.config
-
-            @jax.jit
-            def tail(h, norm_w, head_src, length, rng, temperature):
-                h = rms_norm(h, norm_w, cfg.norm_eps)
-                head = head_src.T if cfg.tie_embeddings else head_src
-                logits = (h[:, -1] @ head.astype(h.dtype)).astype(jnp.float32)
-                if sampled:
-                    rng, sub = jax.random.split(rng)
-                    nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                return nxt.astype(jnp.int32), length + h.shape[1], rng
-
-            self._tail_fns[sampled] = tail
-        return self._tail_fns[sampled]
-
-    def generate(
-        self,
-        input_ids,
-        max_new_tokens: int = 20,
-        temperature: float = 0.0,
-        rng=None,
-        return_device: bool = False,
-    ) -> Union[np.ndarray, jax.Array]:
-        """Greedy/sampled decode; each token streams the offloaded layers once
-        (the reference's per-token cost model, benchmarks/README.md:39-42).
-
-        The loop is fetch-free: tokens accumulate on device and convert to
-        numpy in one transfer at the end (``return_device=True`` skips even
-        that — callers timing the decode fetch after the clock stops).
-        """
-        cfg = self.config
-        input_ids = jnp.asarray(input_ids, jnp.int32)
-        b, s = input_ids.shape
-        max_len = s + max_new_tokens
-        caches = [
-            {
-                "k": jnp.zeros((b, max_len, cfg.kv_heads, cfg.dim_per_head), self.dtype),
-                "v": jnp.zeros((b, max_len, cfg.kv_heads, cfg.dim_per_head), self.dtype),
-            }
-            for _ in range(cfg.num_layers)
-        ]
-        if rng is None:
-            rng = jax.random.key(0)
-        temp = jnp.asarray(max(temperature, 1e-6), jnp.float32)
-
-        prelude = self._get_prelude_fn(max_len)
-        tail = self._get_tail_fn(temperature > 0.0)
-        embed = self._resident("embed_tokens")
-        norm_w = self._resident("final_norm")
-        head_src = embed if cfg.tie_embeddings else self._resident("lm_head")
-        groups = self._group_indices()
-
-        tokens = [input_ids]
-        current = input_ids
-        length = jnp.zeros((), jnp.int32)
-        # max_new_tokens forwards total: prefill samples token 1, then one
-        # decode forward per remaining token (no discarded final pass).
-        for _ in range(max_new_tokens):
-            h, cos, sin, mask = prelude(embed, current, length)
-            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
-                gcaches = tuple(caches[i] for i in idx)
-                h, new_caches = self._get_cached_group_fn(len(idx))(
-                    h, tuple(bufs), gcaches, length, cos, sin, mask
-                )
-                for i, nc in zip(idx, new_caches):
-                    caches[i] = nc
-            nxt, length, rng = tail(h, norm_w, head_src, length, rng, temp)
-            current = nxt[:, None]
-            tokens.append(current)
-        out = jnp.concatenate(tokens, axis=1)
-        return out if return_device else np.asarray(out)
-
-
 class StreamedModel(_LayerStreamer):
     """Generic streaming executor for any model exposing the stream protocol:
 
@@ -533,18 +323,34 @@ class StreamedModel(_LayerStreamer):
             }
         )
 
-    def _get_group_fn(self, n: int):
-        if n not in self._group_fns:
-            unpack, stream_layer = self.packer.unpack, self.model.stream_layer
+    def _jit_cache(self, store_name: str, key, build):
+        """Per-concern jit cache; entries hold the dot_fn they were traced
+        against (a live reference, compared with ``is``) so toggling fp8 on
+        the model recompiles and a collected closure can never alias a stale
+        program via id() reuse."""
+        store = getattr(self, store_name, None)
+        if store is None:
+            store = {}
+            setattr(self, store_name, store)
+        dot_fn = getattr(self.model, "dot_fn", None)
+        entry = store.get(key)
+        if entry is None or entry[0] is not dot_fn:
+            store[key] = (dot_fn, build())
+        return store[key][1]
 
+    def _get_group_fn(self, n: int):
+        unpack, stream_layer = self.packer.unpack, self.model.stream_layer
+
+        def build():
             @jax.jit
             def group_fn(carry, bufs):
                 for buf in bufs:
                     carry = stream_layer(carry, unpack(buf))
                 return carry
 
-            self._group_fns[n] = group_fn
-        return self._group_fns[n]
+            return group_fn
+
+        return self._jit_cache("_group_fns", n, build)
 
     def __call__(self, *args, **kwargs):
         resident = self.resident_tree()
@@ -552,6 +358,130 @@ class StreamedModel(_LayerStreamer):
         for bufs in self._iter_device_layer_groups():
             carry = self._get_group_fn(len(bufs))(carry, tuple(bufs))
         return self.model.stream_suffix(resident, carry)
+
+    # -- streamed KV-cache decode (models exposing the decode protocol:
+    #    init_layer_cache / decode_prefix / stream_layer_cached / decode_suffix)
+
+    def _get_decode_prelude(self, max_len: int):
+        model = self.model
+
+        def build():
+            @jax.jit
+            def prelude(resident, current, length):
+                carry = model.decode_prefix(resident, current, length, max_len)
+                return carry, length + current.shape[1]
+
+            return prelude
+
+        return self._jit_cache("_decode_preludes", max_len, build)
+
+    def _get_decode_group_fn(self, n: int):
+        model, unpack = self.model, self.packer.unpack
+
+        def build():
+            @jax.jit
+            def fn(carry, bufs, caches, length):
+                new_caches = []
+                for buf, c in zip(bufs, caches):
+                    carry, nc = model.stream_layer_cached(carry, unpack(buf), c, length)
+                    new_caches.append(nc)
+                return carry, tuple(new_caches)
+
+            return fn
+
+        return self._jit_cache("_decode_group_fns", n, build)
+
+    def _get_decode_tail(self, sampled: bool):
+        model = self.model
+
+        def build():
+            @jax.jit
+            def tail(resident, carry, rng, temperature):
+                logits = model.decode_suffix(resident, carry)
+                if sampled:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                return nxt.astype(jnp.int32), rng
+
+            return tail
+
+        return self._jit_cache("_decode_tails", sampled, build)
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 20,
+        temperature: float = 0.0,
+        rng=None,
+        return_device: bool = False,
+    ):
+        """Streamed KV-cache decode for any model implementing the decode
+        protocol. Same fetch-free grouped-streaming design as
+        ``StreamedCausalLM.generate``."""
+        if not hasattr(self.model, "stream_layer_cached"):
+            raise TypeError(
+                f"{type(self.model).__name__} has no streamed-decode protocol "
+                "(init_layer_cache/decode_prefix/stream_layer_cached/decode_suffix)"
+            )
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        max_len = s + max_new_tokens
+        L = len(self.layer_buffers)
+        caches = [self.model.init_layer_cache(b, max_len, self.dtype) for _ in range(L)]
+        if rng is None:
+            rng = jax.random.key(0)
+        temp = jnp.asarray(max(temperature, 1e-6), jnp.float32)
+        resident = self.resident_tree()
+        prelude = self._get_decode_prelude(max_len)
+        tail = self._get_decode_tail(temperature > 0.0)
+        groups = self._group_indices()
+
+        tokens = [input_ids]
+        current = input_ids
+        length = jnp.zeros((), jnp.int32)
+        for _ in range(max_new_tokens):
+            carry, new_length = prelude(resident, current, length)
+            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
+                gcaches = tuple(caches[i] for i in idx)
+                carry, new_caches = self._get_decode_group_fn(len(idx))(
+                    carry, tuple(bufs), gcaches, length
+                )
+                for i, nc in zip(idx, new_caches):
+                    caches[i] = nc
+            nxt, rng = tail(resident, carry, rng, temp)
+            length = new_length
+            current = nxt[:, None]
+            tokens.append(current)
+        out = jnp.concatenate(tokens, axis=1)
+        return out if return_device else np.asarray(out)
+
+
+class StreamedCausalLM(StreamedModel):
+    """A causal LM under the streaming executor — kept as a named type for the
+    llama family's dispatch result. All machinery (grouped full-sequence
+    forward, grouped fetch-free KV-cache ``generate``) is inherited from
+    :class:`StreamedModel` via the model's stream/decode protocols; this
+    subclass only preserves the ``resident`` attribute (flat component dict)
+    that benchmarks and tools introspect."""
+
+    def __init__(
+        self,
+        model,
+        resident: dict,
+        layer_buffers,
+        layer_on_device,
+        packer: LayerPacker,
+        dtype=jnp.bfloat16,
+        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
+    ):
+        super().__init__(
+            model, resident, layer_buffers, layer_on_device, packer, dtype,
+            stream_window_bytes=stream_window_bytes,
+        )
+        self.config: TransformerConfig = model.config
+        self.resident = resident
 
 
 def _place_components(params, device_map, offload_dir, dtype, quantization=None):

@@ -110,6 +110,10 @@ class Bert:
         """
         cfg = self.config
         b, s = input_ids.shape
+        if s > cfg.max_seq_len:
+            # learned positions: jnp.take would silently CLAMP out-of-range
+            # indices to the last row — fail loudly instead
+            raise ValueError(f"sequence length {s} exceeds max_seq_len {cfg.max_seq_len}")
         nh = cfg.num_heads
         d = cfg.hidden_size // nh
 
@@ -172,6 +176,9 @@ class Bert:
         cfg = self.config
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
+        if s > cfg.max_seq_len:
+            # learned positions: jnp.take would silently clamp — fail loudly
+            raise ValueError(f"sequence length {s} exceeds max_seq_len {cfg.max_seq_len}")
         emb = resident["embeddings"]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)

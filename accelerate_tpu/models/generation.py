@@ -82,7 +82,7 @@ def _jit_for(model: Llama, name: str, build):
 
 
 def generate(
-    model: Llama,
+    model,
     params: dict,
     input_ids,  # [B, S] prompt
     max_new_tokens: int = 32,
@@ -90,13 +90,24 @@ def generate(
     rng: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
 ) -> np.ndarray:
-    """Greedy (temperature=0) or sampled generation. Returns [B, S+new] ids."""
+    """Greedy (temperature=0) or sampled generation. Returns [B, S+new] ids.
+
+    Works for any causal model implementing the decode protocol —
+    ``init_cache(batch, max_len, dtype)`` + ``forward_with_cache(params, ids,
+    cache) -> (last logits, cache)`` (GPT2 here) — with the llama family's
+    protocol provided by this module."""
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     max_len = s + max_new_tokens
-    cache = init_cache(model.config, b, max_len, dtype=params["embed_tokens"].dtype)
+    dtype = params["embed_tokens"].dtype
+    if hasattr(model, "forward_with_cache"):
+        cache = model.init_cache(b, max_len, dtype=dtype)
+        fwc = model.forward_with_cache
+    else:
+        cache = init_cache(model.config, b, max_len, dtype=dtype)
+        fwc = lambda p, ids, c: forward_with_cache(model, p, ids, c)  # noqa: E731
 
-    prefill = _jit_for(model, "prefill", lambda: jax.jit(lambda p, ids, c: forward_with_cache(model, p, ids, c)))
+    prefill = _jit_for(model, "prefill", lambda: jax.jit(lambda p, ids, c: fwc(p, ids, c)))
     logits, cache = prefill(params, input_ids, cache)
 
     greedy = temperature <= 0.0
@@ -114,7 +125,7 @@ def generate(
     def decode_loop(params, cache, first, keys):
         def step(carry, key):
             cache, token = carry
-            logits, cache = forward_with_cache(model, params, token[:, None], cache)
+            logits, cache = fwc(params, token[:, None], cache)
             nxt = sample(logits, key)
             return (cache, nxt), nxt
 

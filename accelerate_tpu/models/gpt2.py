@@ -93,9 +93,11 @@ class GPT2:
             (r"final_norm", (None,)),
         ]
 
-    # -- one transformer block (shared by apply and the stream protocol) ----
+    # -- one transformer block (shared by apply, streaming, and KV decode) --
 
-    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None)) -> jax.Array:
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), cache=None):
+        """Returns ``h`` (no cache) or ``(h, new_cache)`` when ``cache`` holds
+        {"k","v"} [B, T, N, D] plus the write offset "length"."""
         cfg = self.config
         dot = resolve_dot(self.dot_fn)
         b, s, _ = h.shape
@@ -105,7 +107,18 @@ class GPT2:
         qkv = dot(x, lp["wqkv"]) + lp["bqkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(b, s, nh, d) for t in (q, k, v))
-        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+        new_cache = None
+        if cache is not None:
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache["length"], 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache["length"], 0, 0)
+            )
+            attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)
+            new_cache = {"k": k_cache, "v": v_cache}
+        else:
+            attn = dot_product_attention(q, k, v, mask=mask, causal=True)
         attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
         if rngs[0] is not None:
             attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
@@ -114,7 +127,50 @@ class GPT2:
         mlp_out = dot(jax.nn.gelu(dot(x, lp["w_up"]) + lp["b_up"]), lp["w_down"]) + lp["b_down"]
         if rngs[1] is not None:
             mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
-        return h + mlp_out
+        h = h + mlp_out
+        return h if cache is None else (h, new_cache)
+
+    # -- KV-cache decode protocol (models/generation.py) --------------------
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        cfg = self.config
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {max_len} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (learned positions would silently clamp)"
+            )
+        L, nh = cfg.num_layers, cfg.num_heads
+        d = cfg.hidden_size // nh
+        return {
+            "k": jnp.zeros((L, batch, max_len, nh, d), dtype),
+            "v": jnp.zeros((L, batch, max_len, nh, d), dtype),
+            "length": jnp.zeros((), jnp.int32),
+        }
+
+    def forward_with_cache(self, params: dict, input_ids: jax.Array, cache: dict):
+        """(last-position logits [B, V], updated cache) — the decode protocol
+        generation.generate drives (prefill block or single token)."""
+        cfg = self.config
+        b, s = input_ids.shape
+        length = cache["length"]
+        positions = length + jnp.arange(s)[None, :]
+        h = jnp.take(params["embed_tokens"], input_ids, axis=0) + jnp.take(
+            params["embed_positions"], positions, axis=0
+        )
+        t = cache["k"].shape[2]
+        query_pos = length + jnp.arange(s)
+        mask = (jnp.arange(t)[None, :] <= query_pos[:, None])[None, None]  # [1,1,S,T]
+
+        def body(carry, xs):
+            h = carry
+            lp, k_cache, v_cache = xs
+            h, nc = self._block(h, lp, mask, cache={"k": k_cache, "v": v_cache, "length": length})
+            return h, (nc["k"], nc["v"])
+
+        h, (k_cache, v_cache) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+        h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
+        logits = h[:, -1] @ params["embed_tokens"].T.astype(h.dtype)
+        return logits.astype(jnp.float32), {"k": k_cache, "v": v_cache, "length": length + s}
 
     # -- forward -----------------------------------------------------------
 
@@ -129,6 +185,10 @@ class GPT2:
         """Logits [B, S, V] (LM head = tied token embedding)."""
         cfg = self.config
         b, s = input_ids.shape
+        if s > cfg.max_seq_len:
+            # learned positions: jnp.take would silently CLAMP out-of-range
+            # indices to the last row — fail loudly instead
+            raise ValueError(f"sequence length {s} exceeds max_seq_len {cfg.max_seq_len}")
         if positions is None:
             positions = jnp.arange(s)[None, :]
         h = jnp.take(params["embed_tokens"], input_ids, axis=0) + jnp.take(
@@ -159,11 +219,55 @@ class GPT2:
         h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
         return (h @ params["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
 
+    # -- streamed decode protocol (big_modeling.StreamedModel.generate) ------
+
+    def init_layer_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        """KV cache for ONE layer (the streamed decode keeps per-layer dicts)."""
+        cfg = self.config
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {max_len} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (learned positions would silently clamp)"
+            )
+        nh = cfg.num_heads
+        d = cfg.hidden_size // nh
+        return {
+            "k": jnp.zeros((batch, max_len, nh, d), dtype),
+            "v": jnp.zeros((batch, max_len, nh, d), dtype),
+        }
+
+    def decode_prefix(self, resident, input_ids, length, max_len: int):
+        """Embeddings + causal-over-cache mask → decode carry."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        positions = length + jnp.arange(s)[None, :]
+        h = jnp.take(resident["embed_tokens"], input_ids, axis=0) + jnp.take(
+            resident["embed_positions"], positions, axis=0
+        )
+        q_pos = length + jnp.arange(s)
+        mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
+        return (h, mask)
+
+    def stream_layer_cached(self, carry, lp, cache, length):
+        h, mask = carry
+        h, nc = self._block(h, lp, mask, cache={"k": cache["k"], "v": cache["v"], "length": length})
+        return (h, mask), nc
+
+    def decode_suffix(self, resident, carry):
+        """Last-position logits [B, V] from the decode carry."""
+        h, _ = carry
+        cfg = self.config
+        h = layer_norm(h, resident["final_norm_scale"], resident["final_norm_bias"], cfg.norm_eps)
+        return (h[:, -1] @ resident["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
+
     # -- streaming protocol (big-model dispatch, big_modeling.StreamedModel) --
 
     def stream_prefix(self, resident, input_ids, attention_mask=None):
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
+        if s > self.config.max_seq_len:
+            # learned positions: jnp.take would silently clamp — fail loudly
+            raise ValueError(f"sequence length {s} exceeds max_seq_len {self.config.max_seq_len}")
         h = jnp.take(resident["embed_tokens"], input_ids, axis=0) + jnp.take(
             resident["embed_positions"], jnp.arange(s)[None, :], axis=0
         )

@@ -275,6 +275,67 @@ class Llama:
             return logits, total_aux
         return logits
 
+    # -- streaming protocol (big_modeling.StreamedModel full-sequence path) --
+
+    def stream_prefix(self, resident, input_ids, attention_mask=None):
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        h = jnp.take(resident["embed_tokens"], input_ids, axis=0)
+        cos, sin = rotary_embedding(jnp.arange(s)[None, :], cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+        mask = None
+        if attention_mask is not None:
+            mask = jnp.asarray(attention_mask)[:, None, None, :].astype(bool)
+        return (h, cos, sin, mask)
+
+    def stream_layer(self, carry, lp):
+        h, cos, sin, mask = carry
+        h, _ = decoder_layer(self.config, h, lp, cos, sin, mask, causal=True, dot_fn=self.dot_fn)
+        return (h, cos, sin, mask)
+
+    def stream_suffix(self, resident, carry):
+        h, _, _, _ = carry
+        cfg = self.config
+        h = rms_norm(h, resident["final_norm"], cfg.norm_eps)
+        head = resident["embed_tokens"].T if cfg.tie_embeddings else resident["lm_head"]
+        return (h @ head.astype(h.dtype)).astype(jnp.float32)
+
+    # -- streamed decode protocol (big_modeling.StreamedModel.generate) ------
+
+    def init_layer_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+        cfg = self.config
+        return {
+            "k": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.dim_per_head), dtype),
+            "v": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.dim_per_head), dtype),
+        }
+
+    def decode_prefix(self, resident, input_ids, length, max_len: int):
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        h = jnp.take(resident["embed_tokens"], input_ids, axis=0)
+        positions = length + jnp.arange(s)[None, :]
+        cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+        q_pos = length + jnp.arange(s)
+        mask = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None]
+        return (h, cos, sin, mask)
+
+    def stream_layer_cached(self, carry, lp, cache, length):
+        h, cos, sin, mask = carry
+        h, nc = decoder_layer(
+            self.config, h, lp, cos, sin, mask,
+            cache={"k": cache["k"], "v": cache["v"], "length": length},
+            dot_fn=self.dot_fn,
+        )
+        return (h, cos, sin, mask), {"k": nc["k"], "v": nc["v"]}
+
+    def decode_suffix(self, resident, carry):
+        h, _, _, _ = carry
+        cfg = self.config
+        h = rms_norm(h, resident["final_norm"], cfg.norm_eps)
+        head = resident["embed_tokens"].T if cfg.tie_embeddings else resident["lm_head"]
+        return (h[:, -1] @ head.astype(h.dtype)).astype(jnp.float32)
+
     # -- loss helper -------------------------------------------------------
 
     @staticmethod

@@ -129,6 +129,28 @@ def main():
     assert len(flat2) == n, (len(flat2), n)
     assert set(flat2.astype(int).tolist()) == set(range(n))
 
+    # checkpoint round trip across ranks: save_state writes on rank 0 only,
+    # every rank loads rank 0's directory (shared filesystem on one host)
+    import shutil
+    import tempfile
+
+    d = broadcast_object_list([tempfile.mkdtemp() if state.is_main_process else None])[0]
+    try:
+        ckpt = os.path.join(d, "ckpt")
+        accelerator.save_state(ckpt)
+        saved_a = float(jax.device_get(model.params["a"]))
+        # perturb, then restore
+        model.params = jax.tree.map(lambda p: p + 1.0, model.params)
+        accelerator.load_state(ckpt)
+        restored_a = float(jax.device_get(model.params["a"]))
+        assert abs(restored_a - saved_a) < 1e-6, (saved_a, restored_a)
+        views = ops.gather_object([restored_a])
+        assert all(v == views[0] for v in views), views
+    finally:
+        state.wait_for_everyone()
+        if state.is_main_process:
+            shutil.rmtree(d, ignore_errors=True)
+
     state.wait_for_everyone()
     state.print(json.dumps({"multiprocess_ok": True, "processes": state.num_processes, "devices": state.num_devices}))
 

@@ -253,13 +253,20 @@ def check_checkpoint_resume(accelerator_factory):
             {"x": jnp.asarray(x[s : s + 8]), "y": jnp.asarray(y[s : s + 8])} for s in range(0, 64, 8)
         ]
 
+    from accelerate_tpu import ops
+
     acc = accelerator_factory(1)
     model, opt = acc.prepare(_LinearModel(), optax.adam(0.05))
     for batch in batches()[:4]:
         acc.backward(_linear_loss, batch)
         opt.step()
         opt.zero_grad()
-    with tempfile.TemporaryDirectory() as d:
+    # save_state writes model/optimizer files on the MAIN process only —
+    # every rank must read rank 0's directory, not its own random tmpdir
+    # (single-host multi-process payload: the filesystem is shared)
+    d = tempfile.mkdtemp() if acc.is_main_process else None
+    d = ops.broadcast_object_list([d])[0]
+    try:
         ckpt = os.path.join(d, "ckpt")
         acc.save_state(ckpt)
         for batch in batches()[4:]:
@@ -276,6 +283,14 @@ def check_checkpoint_resume(accelerator_factory):
             opt2.step()
             opt2.zero_grad()
         final_resumed = jax.device_get(model2.params)
+    finally:
+        from accelerate_tpu import PartialState
+
+        PartialState().wait_for_everyone()
+        if PartialState().is_main_process:
+            import shutil
+
+            shutil.rmtree(d, ignore_errors=True)
     for key in final_direct:
         np.testing.assert_allclose(
             np.asarray(final_direct[key]), np.asarray(final_resumed[key]), rtol=1e-5,

@@ -207,3 +207,76 @@ def test_gpt2_in_registry():
     from accelerate_tpu.models import GPT2
 
     assert isinstance(build_model("gpt2-124m"), GPT2)
+
+
+def test_gpt2_generate_kv_cache_matches_recompute():
+    from accelerate_tpu.models import GPT2
+    from accelerate_tpu.models.generation import generate as gen
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(6))
+    ids = np.random.default_rng(6).integers(0, 1024, (2, 7)).astype(np.int32)
+    out = gen(model, params, jnp.asarray(ids), max_new_tokens=5)
+    assert out.shape == (2, 12)
+
+    manual = ids.copy()
+    for _ in range(5):
+        logits = model.apply(params, jnp.asarray(manual))
+        nxt = np.argmax(np.asarray(logits[:, -1], np.float32), axis=-1)
+        manual = np.concatenate([manual, nxt[:, None].astype(np.int32)], axis=1)
+    np.testing.assert_array_equal(out, manual)
+
+
+def test_gpt2_streamed_generate_matches_generate():
+    """Offloaded gpt2 decode (StreamedModel.generate) == in-memory generate."""
+    from accelerate_tpu.big_modeling import cpu_offload, dispatch_model
+    from accelerate_tpu.models import GPT2
+    from accelerate_tpu.models.generation import generate as gen
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(7))
+    ids = jnp.asarray(np.random.default_rng(7).integers(0, 1024, (2, 6)), jnp.int32)
+    expected = gen(model, params, ids, max_new_tokens=4)
+
+    streamed = cpu_offload(model, params, dtype=jnp.float32)
+    got = streamed.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(got, expected)
+    # grouping must not change the decode either
+    cfg = model.config
+    dm = {k: "cpu" if k.startswith("layers.") else "device" for k in streamed.hf_device_map}
+    narrow = dispatch_model(model, params, dm, dtype=jnp.float32, stream_window_bytes=1)
+    assert narrow.group_size == 1
+    np.testing.assert_array_equal(narrow.generate(ids, max_new_tokens=4), expected)
+
+
+def test_learned_position_overflow_raises():
+    """Learned-position models must reject sequences past max_seq_len
+    (jnp.take would silently clamp to the last position row)."""
+    from accelerate_tpu.models import Bert, GPT2
+    from accelerate_tpu.models.generation import generate as gen
+
+    gpt2 = GPT2("gpt2-tiny")  # max_seq_len 256
+    params = gpt2.init(jax.random.key(8))
+    long_ids = jnp.zeros((1, 257), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gpt2.apply(params, long_ids)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gen(gpt2, params, jnp.zeros((1, 250), jnp.int32), max_new_tokens=10)
+
+    bert = Bert("bert-tiny")  # max_seq_len 128
+    bparams = bert.init(jax.random.key(8))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        bert.apply(bparams, jnp.zeros((1, 129), jnp.int32))
+
+
+def test_streamed_learned_position_overflow_raises():
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")  # max_seq_len 256
+    params = model.init(jax.random.key(9))
+    streamed = cpu_offload(model, params, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        streamed(jnp.zeros((1, 257), jnp.int32))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        streamed.generate(jnp.zeros((1, 250), jnp.int32), max_new_tokens=10)
