@@ -4,7 +4,7 @@ Parity: reference big_modeling.py + hooks.py (§2.5 of SURVEY):
 - init_empty_weights (big_modeling.py:56) → ``jax.eval_shape`` abstract init:
   zero bytes allocated, exact shapes/dtypes.
 - infer_auto_device_map + dispatch_model (305) + AlignDevicesHook (hooks.py:
-  212) → ``dispatch_model`` here returns a ``StreamedCausalLM`` that keeps
+  212) → ``dispatch_model`` here returns a ``StreamedModel`` that keeps
   resident components on the TPU and streams cpu/disk layers through HBM with
   an async double buffer. No forward-patching: streaming is explicit in the
   run loop, and the per-layer compute is ONE jit program reused by every
@@ -311,7 +311,9 @@ class StreamedModel(_LayerStreamer):
             stream_window_bytes=stream_window_bytes,
         )
         self.config = getattr(model, "config", None)
-        self._resident_flat = resident_flat
+        # flat {component: array-or-host-buffer} dict; public because tools
+        # and benchmarks introspect resident placement
+        self.resident = self._resident_flat = resident_flat
         self._group_fns: dict = {}
 
     def resident_tree(self) -> dict:
@@ -418,8 +420,8 @@ class StreamedModel(_LayerStreamer):
         return_device: bool = False,
     ):
         """Streamed KV-cache decode for any model implementing the decode
-        protocol. Same fetch-free grouped-streaming design as
-        ``StreamedCausalLM.generate``."""
+        protocol: grouped fetch-free decode — tokens accumulate on device
+        and convert to numpy in one transfer at the end."""
         if not hasattr(self.model, "stream_layer_cached"):
             raise TypeError(
                 f"{type(self.model).__name__} has no streamed-decode protocol "
@@ -458,30 +460,9 @@ class StreamedModel(_LayerStreamer):
         return out if return_device else np.asarray(out)
 
 
-class StreamedCausalLM(StreamedModel):
-    """A causal LM under the streaming executor — kept as a named type for the
-    llama family's dispatch result. All machinery (grouped full-sequence
-    forward, grouped fetch-free KV-cache ``generate``) is inherited from
-    :class:`StreamedModel` via the model's stream/decode protocols; this
-    subclass only preserves the ``resident`` attribute (flat component dict)
-    that benchmarks and tools introspect."""
-
-    def __init__(
-        self,
-        model,
-        resident: dict,
-        layer_buffers,
-        layer_on_device,
-        packer: LayerPacker,
-        dtype=jnp.bfloat16,
-        stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
-    ):
-        super().__init__(
-            model, resident, layer_buffers, layer_on_device, packer, dtype,
-            stream_window_bytes=stream_window_bytes,
-        )
-        self.config: TransformerConfig = model.config
-        self.resident = resident
+# kept as a name for the causal-LM dispatch result (historical API); all
+# machinery lives on StreamedModel via the model's stream/decode protocols
+StreamedCausalLM = StreamedModel
 
 
 def _place_components(params, device_map, offload_dir, dtype, quantization=None):
@@ -568,9 +549,9 @@ def dispatch_model(
     """Place components per ``device_map`` and return the streaming executor.
 
     Parity: reference dispatch_model (big_modeling.py:305) + hook attachment.
-    Llama-family models get ``StreamedCausalLM`` (adds KV-cache ``generate``);
-    any other model implementing the stream protocol (``stream_prefix`` /
-    ``stream_layer`` / ``stream_suffix``) gets the generic ``StreamedModel``.
+    Any model implementing the stream protocol (``stream_prefix`` /
+    ``stream_layer`` / ``stream_suffix``) gets a ``StreamedModel``; models
+    with the decode protocol additionally get KV-cache ``generate``.
     """
     if not isinstance(model, Llama) and not hasattr(model, "stream_layer"):
         raise TypeError(
@@ -592,21 +573,19 @@ def dispatch_model(
         params, device_map, offload_dir, dtype, quantization=quantization
     )
 
-    if isinstance(model, Llama):
-        dispatched = StreamedCausalLM(
-            model, resident, layer_buffers, layer_on_device, packer, dtype=dtype,
-            stream_window_bytes=stream_window_bytes,
-        )
-    else:
-        dispatched = StreamedModel(
-            model, resident, layer_buffers, layer_on_device, packer, dtype,
-            stream_window_bytes=stream_window_bytes,
-        )
+    dispatched = StreamedModel(
+        model, resident, layer_buffers, layer_on_device, packer, dtype,
+        stream_window_bytes=stream_window_bytes,
+    )
     dispatched.hf_device_map = dict(device_map)
     return dispatched
 
 
-def _offload_map(model, layer_target: str) -> dict[str, str]:
+def make_layered_device_map(model, layer_target: str) -> dict[str, str]:
+    """Device map sending every ``layers.*`` entry to ``layer_target``
+    (device/cpu/disk) and every other component to the device — the placement
+    rule behind cpu_offload/disk_offload, exported for scripts that want the
+    same split explicitly."""
     from .utils.modeling import named_component_sizes
 
     return {
@@ -617,27 +596,29 @@ def _offload_map(model, layer_target: str) -> dict[str, str]:
 
 def cpu_offload(model: Any, params: Any, dtype=jnp.bfloat16):
     """Everything streamed from host RAM (reference big_modeling.py:169)."""
-    return dispatch_model(model, params, _offload_map(model, "cpu"), dtype=dtype)
+    return dispatch_model(model, params, make_layered_device_map(model, "cpu"), dtype=dtype)
 
 
 def disk_offload(model: Any, params: Any, offload_dir: str, dtype=jnp.bfloat16):
     """Everything streamed from disk memmaps (reference big_modeling.py:249)."""
-    return dispatch_model(model, params, _offload_map(model, "disk"), offload_dir=offload_dir, dtype=dtype)
+    return dispatch_model(model, params, make_layered_device_map(model, "disk"), offload_dir=offload_dir, dtype=dtype)
 
 
 def load_checkpoint_and_dispatch(
-    model: Llama,
+    model: Any,
     checkpoint: str,
     device_map: dict[str, str] | str = "auto",
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
     stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
-) -> StreamedCausalLM:
-    """Load weights and dispatch (big_modeling.py:498). Accepts both the
-    native flat layout ("layers/wq" stacked tensors) and HuggingFace/torch
-    llama layout ("model.layers.0.self_attn.q_proj.weight" …) — the latter is
-    translated (transpose + restack) by utils/hf_import.py."""
+) -> StreamedModel:
+    """Load weights and dispatch (big_modeling.py:498) for any model
+    implementing the stream protocol. Accepts the native flat layout
+    ("layers/wq" stacked tensors) for every family; llama models additionally
+    accept the HuggingFace/torch layout
+    ("model.layers.0.self_attn.q_proj.weight" …), translated (transpose +
+    restack) by utils/hf_import.py."""
     from .utils.hf_import import load_checkpoint_in_model
 
     params = load_checkpoint_in_model(model, checkpoint)

@@ -68,17 +68,20 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
     return logits.astype(jnp.float32), new_cache
 
 
-def _jit_for(model: Llama, name: str, build):
+def _jit_for(model, name: str, build):
     """Per-model jit cache so repeated generate() calls reuse compilations.
-    Keyed on the model's dot_fn too — swapping fp8 on/off must recompile."""
+    Entries hold the dot_fn they were traced against (live reference,
+    compared with ``is``) so swapping fp8 on/off recompiles and a collected
+    closure can never alias a stale program via id() reuse."""
     cache = getattr(model, "_jit_cache", None)
     if cache is None:
         cache = {}
         model._jit_cache = cache
-    key = (name, id(getattr(model, "dot_fn", None)))
-    if key not in cache:
-        cache[key] = build()
-    return cache[key]
+    dot_fn = getattr(model, "dot_fn", None)
+    entry = cache.get(name)
+    if entry is None or entry[0] is not dot_fn:
+        cache[name] = (dot_fn, build())
+    return cache[name][1]
 
 
 def generate(

@@ -149,28 +149,21 @@ class GPT2:
 
     def forward_with_cache(self, params: dict, input_ids: jax.Array, cache: dict):
         """(last-position logits [B, V], updated cache) — the decode protocol
-        generation.generate drives (prefill block or single token)."""
-        cfg = self.config
+        generation.generate drives (prefill block or single token). One copy
+        of the math: built from decode_prefix/stream_layer_cached/
+        decode_suffix, scanned over the stacked layers."""
         b, s = input_ids.shape
         length = cache["length"]
-        positions = length + jnp.arange(s)[None, :]
-        h = jnp.take(params["embed_tokens"], input_ids, axis=0) + jnp.take(
-            params["embed_positions"], positions, axis=0
-        )
-        t = cache["k"].shape[2]
-        query_pos = length + jnp.arange(s)
-        mask = (jnp.arange(t)[None, :] <= query_pos[:, None])[None, None]  # [1,1,S,T]
+        carry = self.decode_prefix(params, input_ids, length, max_len=cache["k"].shape[2])
 
         def body(carry, xs):
-            h = carry
             lp, k_cache, v_cache = xs
-            h, nc = self._block(h, lp, mask, cache={"k": k_cache, "v": v_cache, "length": length})
-            return h, (nc["k"], nc["v"])
+            carry, nc = self.stream_layer_cached(carry, lp, {"k": k_cache, "v": v_cache}, length)
+            return carry, (nc["k"], nc["v"])
 
-        h, (k_cache, v_cache) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
-        h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
-        logits = h[:, -1] @ params["embed_tokens"].T.astype(h.dtype)
-        return logits.astype(jnp.float32), {"k": k_cache, "v": v_cache, "length": length + s}
+        carry, (k_cache, v_cache) = jax.lax.scan(body, carry, (params["layers"], cache["k"], cache["v"]))
+        logits = self.decode_suffix(params, carry)
+        return logits, {"k": k_cache, "v": v_cache, "length": length + s}
 
     # -- forward -----------------------------------------------------------
 

@@ -30,12 +30,13 @@ import jax.numpy as jnp
 
 from accelerate_tpu import load_checkpoint_and_dispatch
 from accelerate_tpu.checkpointing import save_model_weights
-from accelerate_tpu.models import Llama
+from accelerate_tpu.models import build_model
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description="Big-model inference example.")
-    parser.add_argument("--model", type=str, default="llama-tiny")
+    parser.add_argument("--model", type=str, default="llama-tiny",
+                        help="any registry causal LM (llama-*, gpt2-*)")
     parser.add_argument("--ckpt", type=str, default=None, help="checkpoint dir (demo weights written if absent)")
     parser.add_argument(
         "--placement", type=str, default="cpu", choices=["auto", "device", "cpu", "disk"],
@@ -46,7 +47,7 @@ def main(argv=None):
     parser.add_argument("--temperature", type=float, default=0.0)
     args = parser.parse_args(argv)
 
-    model = Llama(args.model)
+    model = build_model(args.model)
     cfg = model.config
 
     ckpt = args.ckpt or os.path.join("/tmp", f"demo_ckpt_{args.model}")
@@ -60,8 +61,11 @@ def main(argv=None):
     if args.placement == "auto":
         device_map: dict | str = "auto"
     else:
-        device_map = {"embed_tokens": "device", "final_norm": "device", "lm_head": "device"}
-        device_map.update({f"layers.{i}": args.placement for i in range(cfg.num_layers)})
+        # transformer layers go to the chosen tier; embeddings/norms/heads
+        # (whatever the family calls them) stay on device
+        from accelerate_tpu.big_modeling import make_layered_device_map
+
+        device_map = make_layered_device_map(model, args.placement)
     offload_dir = args.offload_dir
     if args.placement == "disk" and offload_dir is None:
         offload_dir = os.path.join("/tmp", f"offload_{args.model}")

@@ -159,6 +159,15 @@ def test_big_model_inference_example(tmp_path):
     assert "tokens:" in out
 
 
+def test_big_model_inference_example_gpt2(tmp_path):
+    out = run_example(
+        "inference/big_model_inference.py", "--model", "gpt2-tiny",
+        "--ckpt", str(tmp_path / "ckpt"), "--placement", "cpu", "--max_new_tokens", "4",
+    )
+    assert re.search(r"generation: [\d.]+ s/token", out)
+    assert "tokens:" in out
+
+
 def test_distributed_inference_example():
     out = run_example("inference/distributed_inference.py", "--max_new_tokens", "4")
     assert re.search(r"process\(es\) generated 5 sequences", out)
