@@ -326,19 +326,10 @@ class StreamedModel(_LayerStreamer):
         )
 
     def _jit_cache(self, store_name: str, key, build):
-        """Per-concern jit cache; entries hold the dot_fn they were traced
-        against (a live reference, compared with ``is``) so toggling fp8 on
-        the model recompiles and a collected closure can never alias a stale
-        program via id() reuse."""
-        store = getattr(self, store_name, None)
-        if store is None:
-            store = {}
-            setattr(self, store_name, store)
-        dot_fn = getattr(self.model, "dot_fn", None)
-        entry = store.get(key)
-        if entry is None or entry[0] is not dot_fn:
-            store[key] = (dot_fn, build())
-        return store[key][1]
+        """Per-concern jit cache, dot_fn-invalidated (utils/jit_cache.py)."""
+        from .utils.jit_cache import dot_keyed_jit
+
+        return dot_keyed_jit(self, store_name, key, build, dot_holder=self.model)
 
     def _get_group_fn(self, n: int):
         unpack, stream_layer = self.packer.unpack, self.model.stream_layer

@@ -69,19 +69,11 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
 
 
 def _jit_for(model, name: str, build):
-    """Per-model jit cache so repeated generate() calls reuse compilations.
-    Entries hold the dot_fn they were traced against (live reference,
-    compared with ``is``) so swapping fp8 on/off recompiles and a collected
-    closure can never alias a stale program via id() reuse."""
-    cache = getattr(model, "_jit_cache", None)
-    if cache is None:
-        cache = {}
-        model._jit_cache = cache
-    dot_fn = getattr(model, "dot_fn", None)
-    entry = cache.get(name)
-    if entry is None or entry[0] is not dot_fn:
-        cache[name] = (dot_fn, build())
-    return cache[name][1]
+    """Per-model jit cache so repeated generate() calls reuse compilations;
+    dot_fn-invalidated (see utils/jit_cache.py)."""
+    from ..utils.jit_cache import dot_keyed_jit
+
+    return dot_keyed_jit(model, "_jit_cache", name, build)
 
 
 def generate(

@@ -283,23 +283,29 @@ def flash_attention(
     k: jax.Array,  # [B, S, KV, D]
     v: jax.Array,  # [B, S, KV, D]
     kv_mask: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
 ) -> jax.Array:
     """Causal flash attention with the ``attention_fn`` hook signature.
 
-    Falls back to the einsum path when a padding mask is present or when the
-    sequence does not tile (v1 scope).
+    Block sizes adapt DOWNWARD (halving, floor 128) until they divide the
+    sequence, so any seq that is a multiple of 128 runs the kernel; only a
+    padding mask or an untileable length falls back to the einsum path.
     """
     b, s, n, d = q.shape
-    if kv_mask is not None or s % block_q or s % block_k or s < max(block_q, block_k):
+    bq, bk = min(block_q, s), min(block_k, s)
+    while bq > 128 and s % bq:
+        bq //= 2
+    while bk > 128 and s % bk:
+        bk //= 2
+    if kv_mask is not None or bq % 128 or bk % 128 or s % bq or s % bk:
         from ..models.attention import dot_product_attention
 
         mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)
         return dot_product_attention(q, k, v, mask=mask, causal=True)
     scale = 1.0 / math.sqrt(d)
     out = _flash_attention_bnsd(
-        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), block_q, block_k, scale
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), bq, bk, scale
     )
     return out.swapaxes(1, 2)
 

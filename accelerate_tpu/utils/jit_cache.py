@@ -1,0 +1,28 @@
+"""Shared dot_fn-aware jit cache.
+
+Models and streaming executors cache compiled programs keyed by shape-like
+keys, but every program also closes over the model's ``dot_fn`` hook (fp8
+projection compute). Entries therefore hold the dot_fn they were traced
+against — a LIVE reference compared with ``is`` — so toggling fp8 recompiles
+and a garbage-collected closure can never alias a stale program via id()
+reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def dot_keyed_jit(owner: Any, store_attr: str, key, build: Callable, dot_holder: Any = None):
+    """Return ``build()``'s result cached on ``owner.<store_attr>[key]``,
+    invalidated when ``dot_holder.dot_fn`` is a different object than the one
+    the entry was built under. ``dot_holder`` defaults to ``owner``."""
+    store = getattr(owner, store_attr, None)
+    if store is None:
+        store = {}
+        setattr(owner, store_attr, store)
+    dot_fn = getattr(dot_holder if dot_holder is not None else owner, "dot_fn", None)
+    entry = store.get(key)
+    if entry is None or entry[0] is not dot_fn:
+        store[key] = (dot_fn, build())
+    return store[key][1]

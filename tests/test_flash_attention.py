@@ -22,7 +22,8 @@ def _qkv(b=2, s=256, n=4, kv=4, d=64, seed=0):
 @pytest.mark.parametrize("kv", [4, 2])
 def test_forward_matches_reference(kv):
     q, k, v = _qkv(kv=kv)
-    got = flash_attention(q, k, v)
+    # explicit 128 blocks: cover the smallest kernel tiling directly
+    got = flash_attention(q, k, v, block_q=128, block_k=128)
     want = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
@@ -32,7 +33,7 @@ def test_gradients_match_reference(kv):
     q, k, v = _qkv(b=1, s=256, n=4, kv=kv, d=64, seed=1)
 
     def loss_flash(q, k, v):
-        return (flash_attention(q, k, v) ** 2).sum()
+        return (flash_attention(q, k, v, block_q=128, block_k=128) ** 2).sum()
 
     def loss_ref(q, k, v):
         return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
@@ -78,3 +79,38 @@ def test_auto_attention_dispatch():
         np.asarray(dot_product_attention(q2, k2, v2, causal=True)),
         rtol=1e-6,
     )
+
+
+def test_default_blocks_kernel_matches_reference():
+    """The 512-block production default, on a sequence long enough to tile."""
+    q, k, v = _qkv(b=1, s=1024, n=2, kv=2, d=64, seed=3)
+    got = flash_attention(q, k, v)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_default_blocks_gradients_match_reference():
+    """Backward kernels at the production default (unequal 256/512 blocks)."""
+    q, k, v = _qkv(b=1, s=1024, n=2, kv=2, d=64, seed=4)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_block_adaptation_keeps_kernel_for_128_multiples():
+    """seq = 2176 (a 128-multiple that 256/512 blocks do not divide) must
+    still match the reference — blocks adapt down instead of falling back."""
+    q, k, v = _qkv(b=1, s=384, n=2, kv=2, d=64, seed=5)  # 384 % 256 != 0
+    got = flash_attention(q, k, v)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
