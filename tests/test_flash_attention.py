@@ -108,9 +108,10 @@ def test_default_blocks_gradients_match_reference():
 
 
 def test_block_adaptation_keeps_kernel_for_128_multiples():
-    """seq = 2176 (a 128-multiple that 256/512 blocks do not divide) must
-    still match the reference — blocks adapt down instead of falling back."""
-    q, k, v = _qkv(b=1, s=384, n=2, kv=2, d=64, seed=5)  # 384 % 256 != 0
+    """A 128-multiple that neither default block divides (640: 512->256->128
+    and 256->128 both halve to the floor) must still match the reference —
+    blocks adapt down instead of falling back to the einsum path."""
+    q, k, v = _qkv(b=1, s=640, n=2, kv=2, d=64, seed=5)
     got = flash_attention(q, k, v)
     want = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
