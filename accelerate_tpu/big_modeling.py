@@ -628,6 +628,7 @@ def load_and_quantize_model(
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
     dtype=jnp.bfloat16,
+    stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
 ):
     """Reference utils/bnb.py:44 — load a checkpoint and dispatch with layer
     weights quantized to int8/int4 (per-output-channel scales, dequantized on
@@ -646,4 +647,5 @@ def load_and_quantize_model(
         offload_dir=offload_dir,
         dtype=dtype,
         quantization=quantization_config,
+        stream_window_bytes=stream_window_bytes,
     )
