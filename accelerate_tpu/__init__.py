@@ -12,6 +12,7 @@ from .state import AcceleratorState, GradientState, PartialState
 from .accelerator import Accelerator, PreparedModel
 from .big_modeling import (
     cpu_offload,
+    cpu_offload_with_hook,
     disk_offload,
     dispatch_model,
     init_empty_weights,

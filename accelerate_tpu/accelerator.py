@@ -179,16 +179,28 @@ class Accelerator:
             elif isinstance(handler, InitProcessGroupKwargs):
                 # consumed by PartialState._bootstrap_distributed (env is the
                 # transport; also covers DistributedInitKwargs). The rendezvous
-                # runs ONCE — passing this after it is a silent no-op, so fail
-                # (an early PartialState in a single process is fine: no
-                # rendezvous happened, the env still reaches any later one).
-                import jax
-
-                if jax.distributed.is_initialized():
+                # runs ONCE — passing this after it is a silent no-op, so fail.
+                # PartialState's bootstrap is also once-only (sticky _ready
+                # flag): if ANY PartialState already exists, coordinator fields
+                # set here would never be consumed and the job would silently
+                # run single-process. Timeout-only handlers are still fine
+                # late — they only matter if a rendezvous happens afterwards.
+                carries_coordinator = any(
+                    getattr(handler, f, None) is not None
+                    for f in ("coordinator_address", "num_processes", "process_id")
+                )
+                if jax.distributed.is_initialized() or (
+                    carries_coordinator and PartialState._shared_state
+                ):
                     raise ValueError(
-                        "InitProcessGroupKwargs must be passed before the "
-                        "distributed rendezvous — jax.distributed is already "
-                        "initialized."
+                        "InitProcessGroupKwargs/DistributedInitKwargs with "
+                        "coordinator fields must be passed before any "
+                        "PartialState/Accelerator is created — the distributed "
+                        "bootstrap runs once, so these fields would be "
+                        "silently ignored now. Construct the Accelerator with "
+                        "these kwargs first (or export ACCELERATE_COORDINATOR_"
+                        "ADDRESS / ACCELERATE_NUM_PROCESSES / "
+                        "ACCELERATE_PROCESS_ID before the process starts)."
                     )
                 if getattr(handler, "coordinator_address", None):
                     os.environ["ACCELERATE_COORDINATOR_ADDRESS"] = handler.coordinator_address
@@ -211,11 +223,14 @@ class Accelerator:
             # FSDP plugin activation checkpointing ≙ full recompute inside each
             # layer (Megatron recompute_activations semantics; reference
             # accelerator.py:1450-1464 applies torch checkpoint wrappers
-            # post-wrap). Scan models apply this per layer (prepare_model).
+            # post-wrap), EXCEPT the flash-attention out/lse — keeping those
+            # skips the kernel's second forward pass in the backward and is
+            # byte-identical to "full" for paths that never hit the kernel.
+            # Scan models apply this per layer (prepare_model).
             # Copy: the config object is caller-owned and may be shared.
             import dataclasses as _dc
 
-            self.compilation_config = _dc.replace(self.compilation_config, remat_policy="full")
+            self.compilation_config = _dc.replace(self.compilation_config, remat_policy="save_flash")
 
         if self.state.mixed_precision == "fp16" and self.loss_scale_kwargs is None:
             self.loss_scale_kwargs = LossScaleKwargs()
@@ -387,14 +402,18 @@ class Accelerator:
         # object may be re-prepared under a different Accelerator/mesh, and a
         # stale pipeline_fn/attention_fn closes over the old mesh.
         if hasattr(model, "attention_fn"):
+            # bidirectional models (Bert: causal_attention=False) get a
+            # non-causal ring and skip the causal-only flash kernel
+            causal = getattr(model, "causal_attention", True)
             if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
                 # sequence axis active: swap in exact ring attention so K/V
                 # blocks rotate over ICI instead of being all-gathered
                 from .parallel.ring_attention import make_ring_attention
 
-                model.attention_fn = make_ring_attention(self.mesh)
+                model.attention_fn = make_ring_attention(self.mesh, causal=causal)
             elif (
-                self.compilation_config.flash_attention_min_seq
+                causal
+                and self.compilation_config.flash_attention_min_seq
                 and jax.default_backend() == "tpu"
             ):
                 # long sequences stream through the Pallas flash kernel; short
@@ -432,7 +451,7 @@ class Accelerator:
                 # drops from ~(P-1)/(2P-1) ≈ 45% at M=P to <20% at M=4P
                 num_micro = (
                     self.model_parallel_plugin.num_microbatches
-                    if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 1
+                    if self.model_parallel_plugin is not None and self.model_parallel_plugin.num_microbatches > 0
                     else 4 * self.mesh.shape[MESH_AXIS_PIPELINE]
                 )
                 virtual = (
@@ -440,9 +459,11 @@ class Accelerator:
                     if self.model_parallel_plugin is not None
                     else 1
                 )
+                # the model's own per-layer function drives the schedule
+                # (reads self.dot_fn at trace time, so fp8 stays wired)
                 model.pipeline_fn = make_pipeline_layers_fn(
                     model.config, self.mesh, num_micro,
-                    dot_fn=getattr(model, "dot_fn", None), virtual_stages=virtual,
+                    layer_fn=model.pipeline_layer, virtual_stages=virtual,
                 )
             else:
                 model.pipeline_fn = None
@@ -569,6 +590,32 @@ class Accelerator:
             elif isinstance(obj, (BaseDataLoader,)) or self._is_loader_like(obj):
                 prepared_map[i] = self.prepare_data_loader(obj)
             elif callable(obj):
+                # Last duck-type bucket: only SCHEDULE-shaped callables (one
+                # required argument — the step count) may fall through here. A
+                # loss function silently wrapped in AcceleratedScheduler fails
+                # confusingly much later (reference's prepare dispatches on
+                # nn.Module/Optimizer/DataLoader types, accelerator.py:1178) —
+                # reject with the fix spelled out instead.
+                import inspect
+
+                try:
+                    required = [
+                        p
+                        for p in inspect.signature(obj).parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty
+                    ]
+                    schedule_shaped = len(required) <= 1
+                except (TypeError, ValueError):  # builtins without signatures
+                    schedule_shaped = True
+                if not schedule_shaped:
+                    raise TypeError(
+                        f"prepare() got a callable ({getattr(obj, '__name__', obj)!r}) "
+                        f"taking {len(required)} required arguments — a learning-rate "
+                        "schedule takes one (the step count). If this is a loss "
+                        "function, pass it to backward()/compiled_step() instead; "
+                        "for a custom schedule call prepare_scheduler() explicitly."
+                    )
                 prepared_map[i] = self.prepare_scheduler(obj)
             else:
                 prepared_map[i] = obj

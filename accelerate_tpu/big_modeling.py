@@ -305,6 +305,7 @@ class StreamedModel(_LayerStreamer):
     def __init__(
         self, model, resident_flat, layer_buffers, layer_on_device, packer, dtype,
         stream_window_bytes: int = DEFAULT_STREAM_WINDOW_BYTES,
+        host_shadow: Optional[dict] = None,
     ):
         super().__init__(
             model, layer_buffers, layer_on_device, packer, dtype,
@@ -315,6 +316,58 @@ class StreamedModel(_LayerStreamer):
         # and benchmarks introspect resident placement
         self.resident = self._resident_flat = resident_flat
         self._group_fns: dict = {}
+        # host copies of device-placed buffers: lets evict() free the HBM
+        # without a device→host fetch (see _place_components)
+        self._host_shadow = host_shadow or {"resident": {}, "layers": {}}
+        self._evicted = False
+        # another model's offload hook, run before this model executes
+        # (cpu_offload_with_hook pipeline-of-models chaining)
+        self._prev_hook: Optional["UserOffloadHook"] = None
+
+    # -- evict / restore (reference cpu_offload_with_hook, big_modeling.py:
+    # 215-302: run model A, evict, run model B within one HBM budget) --------
+
+    def evict(self) -> "StreamedModel":
+        """Drop every device-resident buffer back to its host copy, freeing
+        the HBM this model holds. The placement map is unchanged — the next
+        :meth:`restore` (or any execution, which restores implicitly)
+        re-uploads exactly the original resident set."""
+        if self._evicted:
+            return self
+        for key, host in self._host_shadow["resident"].items():
+            live = self._resident_flat[key]
+            if isinstance(live, jax.Array):
+                live.delete()
+            self._resident_flat[key] = host
+        for i, packed in self._host_shadow["layers"].items():
+            live = self.layer_buffers[i]
+            for part in live if isinstance(live, tuple) else (live,):
+                if isinstance(part, jax.Array):
+                    part.delete()
+            self.layer_buffers[i] = packed
+            self.layer_on_device[i] = False
+        self._evicted = True
+        return self
+
+    def restore(self) -> "StreamedModel":
+        """Re-upload the originally device-placed buffers after an evict."""
+        if not self._evicted:
+            return self
+        for key in self._host_shadow["resident"]:
+            self._resident_flat[key] = jax.device_put(jnp.asarray(self._resident_flat[key]))
+        for i in self._host_shadow["layers"]:
+            self.layer_buffers[i] = _device_put_packed(self.layer_buffers[i])
+            self.layer_on_device[i] = True
+        self._evicted = False
+        return self
+
+    def _before_execute(self):
+        """Pipeline-of-models choreography: evict the previous model in the
+        chain, then make sure this one is resident."""
+        if self._prev_hook is not None:
+            self._prev_hook.offload()
+        if self._evicted:
+            self.restore()
 
     def resident_tree(self) -> dict:
         """Nested resident params, streaming host/disk leaves to the device."""
@@ -346,6 +399,7 @@ class StreamedModel(_LayerStreamer):
         return self._jit_cache("_group_fns", n, build)
 
     def __call__(self, *args, **kwargs):
+        self._before_execute()
         resident = self.resident_tree()
         carry = self.model.stream_prefix(resident, *args, **kwargs)
         for bufs in self._iter_device_layer_groups():
@@ -418,6 +472,7 @@ class StreamedModel(_LayerStreamer):
                 f"{type(self.model).__name__} has no streamed-decode protocol "
                 "(init_layer_cache/decode_prefix/stream_layer_cached/decode_suffix)"
             )
+        self._before_execute()
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
         max_len = s + max_new_tokens
@@ -456,16 +511,118 @@ class StreamedModel(_LayerStreamer):
 StreamedCausalLM = StreamedModel
 
 
+class Seq2SeqStreamedModel(StreamedModel):
+    """Streaming executor for encoder-decoder models (T5 family).
+
+    Reference parity: examples/inference/t5.py (pippy PP over T5). The
+    full-sequence ``__call__`` path is inherited unchanged (the model's
+    stream_prefix runs the encoder). ``generate`` differs from the causal
+    loop: ``input_ids`` are ENCODER inputs, run once through a jitted
+    resident-encoder program; the decode loop then streams the decoder stack
+    per token starting from ``config.decoder_start_token_id``, with the
+    encoder output carried into every layer's cross-attention.
+    """
+
+    def _get_encoder_fn(self, s_enc: int, has_mask: bool):
+        model = self.model
+
+        def build():
+            if has_mask:
+                return jax.jit(lambda resident, ids, am: model.encode(resident, ids, am))
+            return jax.jit(lambda resident, ids: model.encode(resident, ids))
+
+        return self._jit_cache("_encoder_fns", (s_enc, has_mask), build)
+
+    def _get_seq2seq_prelude(self, max_len: int):
+        model = self.model
+
+        def build():
+            @jax.jit
+            def prelude(resident, current, length, enc_out, enc_mask):
+                carry = model.decode_prefix(
+                    resident, current, length, max_len, enc_out=enc_out, enc_mask=enc_mask
+                )
+                return carry, length + current.shape[1]
+
+            return prelude
+
+        return self._jit_cache("_decode_preludes", max_len, build)
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 20,
+        temperature: float = 0.0,
+        rng=None,
+        return_device: bool = False,
+        attention_mask=None,
+    ):
+        """Streamed seq2seq decode: one encoder pass, then fetch-free
+        KV-cached decoder streaming (tokens accumulate on device). Returns
+        the DECODER sequence [B, 1 + max_new_tokens] (start token included)."""
+        self._before_execute()
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b = input_ids.shape[0]
+        max_len = 1 + max_new_tokens
+        L = len(self.layer_buffers)
+        caches = [self.model.init_layer_cache(b, max_len, self.dtype) for _ in range(L)]
+        if rng is None:
+            rng = jax.random.key(0)
+        temp = jnp.asarray(max(temperature, 1e-6), jnp.float32)
+        resident = self.resident_tree()
+
+        has_mask = attention_mask is not None
+        enc_fn = self._get_encoder_fn(input_ids.shape[1], has_mask)
+        if has_mask:
+            attention_mask = jnp.asarray(attention_mask, jnp.int32)
+            enc_out = enc_fn(resident, input_ids, attention_mask)
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        else:
+            enc_out = enc_fn(resident, input_ids)
+            enc_mask = jnp.ones((b, 1, 1, input_ids.shape[1]), bool)
+
+        prelude = self._get_seq2seq_prelude(max_len)
+        tail = self._get_decode_tail(temperature > 0.0)
+        groups = self._group_indices()
+
+        current = jnp.full((b, 1), self.config.decoder_start_token_id, jnp.int32)
+        tokens = [current]
+        length = jnp.zeros((), jnp.int32)
+        for _ in range(max_new_tokens):
+            carry, new_length = prelude(resident, current, length, enc_out, enc_mask)
+            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
+                gcaches = tuple(caches[i] for i in idx)
+                carry, new_caches = self._get_decode_group_fn(len(idx))(
+                    carry, tuple(bufs), gcaches, length
+                )
+                for i, nc in zip(idx, new_caches):
+                    caches[i] = nc
+            nxt, rng = tail(resident, carry, rng, temp)
+            length = new_length
+            current = nxt[:, None]
+            tokens.append(current)
+        out = jnp.concatenate(tokens, axis=1)
+        return out if return_device else np.asarray(out)
+
+
 def _place_components(params, device_map, offload_dir, dtype, quantization=None):
-    """Shared placement: resident leaves + packed per-layer buffers."""
+    """Shared placement: resident leaves + packed per-layer buffers.
+
+    Also returns ``host_shadow`` — host copies of every DEVICE-placed buffer,
+    kept so :meth:`StreamedModel.evict` can free the HBM without a
+    device→host fetch (a single D2H fetch permanently degrades H2D DMA on
+    tunneled transports; the weights already exist on the host here).
+    """
     np_dtype = _np_dtype(dtype)
 
     resident: dict[str, Any] = {}
+    host_shadow: dict[str, Any] = {"resident": {}, "layers": {}}
     for key, leaf in _flat_items({k: v for k, v in params.items() if k != "layers"}):
         target = device_map.get(key.replace("/", "."), "device")
         host = np.asarray(leaf, np_dtype)
         if target == "device":
             resident[key] = jax.device_put(jnp.asarray(host))
+            host_shadow["resident"][key] = host
         elif target == "cpu":
             resident[key] = host
         elif target == "disk":
@@ -511,6 +668,7 @@ def _place_components(params, device_map, offload_dir, dtype, quantization=None)
         if target == "device":
             layer_buffers.append(_device_put_packed(packed))
             layer_on_device.append(True)
+            host_shadow["layers"][i] = packed
         elif target == "cpu":
             layer_buffers.append(packed)
             layer_on_device.append(False)
@@ -524,7 +682,7 @@ def _place_components(params, device_map, offload_dir, dtype, quantization=None)
             raise ValueError(f"Unknown target {target!r} for layers.{i}")
     if disk_index:
         save_offload_index(disk_index, offload_dir)
-    return resident, packer, layer_buffers, layer_on_device
+    return resident, packer, layer_buffers, layer_on_device, host_shadow
 
 
 def dispatch_model(
@@ -560,13 +718,14 @@ def dispatch_model(
         )
     check_device_map(model, device_map)
 
-    resident, packer, layer_buffers, layer_on_device = _place_components(
+    resident, packer, layer_buffers, layer_on_device, host_shadow = _place_components(
         params, device_map, offload_dir, dtype, quantization=quantization
     )
 
-    dispatched = StreamedModel(
+    cls = Seq2SeqStreamedModel if getattr(model, "is_encoder_decoder", False) else StreamedModel
+    dispatched = cls(
         model, resident, layer_buffers, layer_on_device, packer, dtype,
-        stream_window_bytes=stream_window_bytes,
+        stream_window_bytes=stream_window_bytes, host_shadow=host_shadow,
     )
     dispatched.hf_device_map = dict(device_map)
     return dispatched
@@ -593,6 +752,48 @@ def cpu_offload(model: Any, params: Any, dtype=jnp.bfloat16):
 def disk_offload(model: Any, params: Any, offload_dir: str, dtype=jnp.bfloat16):
     """Everything streamed from disk memmaps (reference big_modeling.py:249)."""
     return dispatch_model(model, params, make_layered_device_map(model, "disk"), offload_dir=offload_dir, dtype=dtype)
+
+
+class UserOffloadHook:
+    """User handle to evict a dispatched model (reference UserCpuOffloadHook,
+    hooks.py). ``offload()`` frees the model's HBM; the model restores itself
+    automatically on its next execution."""
+
+    def __init__(self, streamed: StreamedModel):
+        self.model = streamed
+
+    def offload(self) -> None:
+        self.model.evict()
+
+    def remove(self) -> None:
+        """Detach the chained previous-model hook (parity with the reference's
+        remove_hook_from_module semantics)."""
+        self.model._prev_hook = None
+
+
+def cpu_offload_with_hook(
+    model: Any,
+    params: Any,
+    dtype=jnp.bfloat16,
+    prev_module_hook: Optional[UserOffloadHook] = None,
+) -> tuple[StreamedModel, UserOffloadHook]:
+    """Pipeline-of-models offload (reference big_modeling.py:215-302).
+
+    Unlike :func:`cpu_offload` — which streams every layer on every forward —
+    the model here is dispatched fully DEVICE-resident and *stays* resident
+    across executions; it only leaves the HBM when the returned hook's
+    ``offload()`` runs. Chain hooks through ``prev_module_hook`` to run
+    several models alternately inside one HBM budget::
+
+        lm1, hook1 = cpu_offload_with_hook(model1, params1)
+        lm2, hook2 = cpu_offload_with_hook(model2, params2, prev_module_hook=hook1)
+        lm1(x)          # model1 uploads
+        lm2(y)          # model1 evicts first, then model2 uploads
+        hook2.offload() # free model2 explicitly
+    """
+    dispatched = dispatch_model(model, params, make_layered_device_map(model, "device"), dtype=dtype)
+    dispatched._prev_hook = prev_module_hook
+    return dispatched, UserOffloadHook(dispatched)
 
 
 def load_checkpoint_and_dispatch(

@@ -1,21 +1,98 @@
 """`accelerate-tpu estimate-memory` — static memory estimate for a model.
 
 Parity: reference commands/estimate.py:215-299 (meta-device model → per-dtype
-table). Here the abstract init is `jax.eval_shape`, which is exact and free:
-no weights are materialized.
+table, loadable from any Hub checkpoint). Three input forms:
+
+- a registry name (``llama-7b``): exact count via ``models.param_count``;
+- ``params=N``: raw parameter count;
+- a checkpoint path (file or directory): shapes/dtypes are read from the
+  safetensors headers (8-byte length + JSON — zero tensor bytes touched) or
+  the ``.npz`` member headers, covering anything ``save_model_weights``
+  produced, sharded or not.
 """
 
 from __future__ import annotations
+
+import json
+import os
+import struct
 
 
 def register_subcommand(subparsers):
     parser = subparsers.add_parser(
         "estimate-memory", help="Estimate device memory for training/inference of a model"
     )
-    parser.add_argument("model_name", help="Built-in model name (e.g. llama-7b, bert-base) or params=N")
+    parser.add_argument(
+        "model_name",
+        help="Built-in model name (e.g. llama-7b, bert-base), params=N, or a "
+        "checkpoint path (.safetensors/.npz file or directory)",
+    )
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8"])
     parser.set_defaults(func=run)
     return parser
+
+
+# safetensors dtype tags and numpy dtype names → bytes per element
+_STORED_DTYPE_BYTES = {
+    "F64": 8, "F32": 4, "F16": 2, "BF16": 2, "I64": 8, "I32": 4, "I16": 2,
+    "I8": 1, "U8": 1, "BOOL": 1,
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2, "int64": 8,
+    "int32": 4, "int16": 2, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def _safetensors_entries(path: str) -> dict[str, tuple[tuple, str]]:
+    """{tensor name: (shape, dtype tag)} from the header only."""
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(n))
+    return {
+        k: (tuple(v["shape"]), v["dtype"]) for k, v in header.items() if k != "__metadata__"
+    }
+
+
+def _npz_entries(path: str) -> dict[str, tuple[tuple, str]]:
+    """{name: (shape, dtype)} from each zip member's .npy header."""
+    import zipfile
+
+    from numpy.lib import format as npf
+
+    out = {}
+    with zipfile.ZipFile(path) as z:
+        for name in z.namelist():
+            with z.open(name) as f:
+                version = npf.read_magic(f)
+                if version == (1, 0):
+                    shape, _, dtype = npf.read_array_header_1_0(f)
+                else:
+                    shape, _, dtype = npf.read_array_header_2_0(f)
+            key = name[:-4] if name.endswith(".npy") else name
+            out[key] = (shape, dtype.name)
+    return out
+
+
+def checkpoint_entries(path: str) -> dict[str, tuple[tuple, str]]:
+    """Tensor shapes/dtypes for a checkpoint file or directory, header-only."""
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        names = sorted(os.listdir(path))
+        # prefer index-listed shards (canonical), else every weights file
+        indexed: set[str] = set()
+        for name in names:
+            if name.endswith(".index.json"):
+                with open(os.path.join(path, name)) as f:
+                    indexed.update(json.load(f).get("weight_map", {}).values())
+        chosen = sorted(indexed) if indexed else [
+            n for n in names if n.endswith((".safetensors", ".npz"))
+        ]
+        files = [os.path.join(path, n) for n in chosen]
+    if not files:
+        raise FileNotFoundError(f"No .safetensors/.npz weights under {path!r}")
+    entries: dict[str, tuple[tuple, str]] = {}
+    for f in files:
+        entries.update(_npz_entries(f) if f.endswith(".npz") else _safetensors_entries(f))
+    return entries
 
 
 _DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5, "fp8": 1}
@@ -38,8 +115,26 @@ def count_params(model_name: str) -> int:
 
 
 def run(args) -> int:
-    n = count_params(args.model_name)
-    print(f"Model: {args.model_name} — {n / 1e9:.2f}B parameters")
+    if os.path.exists(args.model_name):
+        entries = checkpoint_entries(args.model_name)
+        import numpy as np
+
+        n = sum(int(np.prod(shape)) for shape, _ in entries.values())
+        stored = sum(
+            int(np.prod(shape)) * _STORED_DTYPE_BYTES.get(dtype, 4)
+            for shape, dtype in entries.values()
+        )
+        largest_key, (largest_shape, largest_dtype) = max(
+            entries.items(), key=lambda kv: int(np.prod(kv[1][0]))
+        )
+        print(
+            f"Checkpoint: {args.model_name} — {len(entries)} tensors, "
+            f"{n:,} parameters, {_convert_bytes(stored)} stored"
+        )
+        print(f"Largest tensor: {largest_key} {list(largest_shape)} {largest_dtype}")
+    else:
+        n = count_params(args.model_name)
+        print(f"Model: {args.model_name} — {n / 1e9:.2f}B parameters")
     header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}"
     print(header)
     print("-" * len(header))

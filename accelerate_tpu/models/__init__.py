@@ -5,9 +5,10 @@ from .generation import generate
 from .gpt2 import GPT2
 from .llama import Llama
 from .moe import MoEBlock
+from .t5 import T5
 
 
-_ARCHS = {"llama": Llama, "bert": Bert, "gpt2": GPT2}
+_ARCHS = {"llama": Llama, "bert": Bert, "gpt2": GPT2, "t5": T5}
 
 
 def build_model(name: str):

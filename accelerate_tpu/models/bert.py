@@ -31,6 +31,10 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> j
 class Bert:
     """(init, apply) pair for an encoder with a classification head."""
 
+    # bidirectional attention: prepare_model builds ring attention with
+    # causal=False and skips the (causal-only) flash kernel
+    causal_attention = False
+
     def __init__(self, config: TransformerConfig | str):
         self.config = get_config(config) if isinstance(config, str) else config
         assert self.config.arch == "bert"
@@ -38,6 +42,9 @@ class Bert:
         self.remat_layers = False
         # fp8 projection compute (ops/fp8.fp8_dot), set by prepare_model
         self.dot_fn = None
+        # hooks set by Accelerator.prepare_model (see models/llama.py)
+        self.attention_fn = None
+        self.pipeline_fn = None
 
     def init(self, rng: jax.Array) -> dict:
         if not hasattr(self, "_init_jit"):
@@ -83,12 +90,16 @@ class Bert:
         }
 
     def partition_rules(self) -> list[tuple[str, tuple]]:
+        from ..utils.constants import MESH_AXIS_PIPELINE
+
         t = MESH_AXIS_TENSOR
+        p = MESH_AXIS_PIPELINE  # stacked-layer leading dim; size-1 axis = no-op
         return [
             (r"embeddings/word", (t, None)),
-            (r"layers/(wq|wk|wv|w_up)", (None, None, t)),
-            (r"layers/(bq|bk|bv|b_up)", (None, t)),
-            (r"layers/(wo|w_down)", (None, t, None)),
+            (r"layers/(wq|wk|wv|w_up)", (p, None, t)),
+            (r"layers/(bq|bk|bv|b_up)", (p, t)),
+            (r"layers/(wo|w_down)", (p, t, None)),
+            (r"layers/.*(norm|bo|b_down)", (p, None)),
             (r"(norm|bias|bo|b_down)", (None,)),
             (r"pooler/w", (None, t)),
             (r"classifier", (None,)),
@@ -139,35 +150,63 @@ class Bert:
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
 
-        dot = resolve_dot(self.dot_fn)
+        if self.pipeline_fn is not None:
+            h, _ = self.pipeline_fn(
+                params["layers"], h, mask, attention_mask,
+                dropout_rng=layers_rng if use_dropout else None,
+            )
+        else:
+            def layer(h, xs):
+                lp = xs[0] if use_dropout else xs
+                rngs = tuple(xs[1]) if use_dropout else (None, None)
+                h = self._block(h, lp, mask, rngs, kv_mask=attention_mask)
+                return h, None
 
-        def layer(h, xs):
-            lp = xs[0] if use_dropout else xs
-            rngs = xs[1] if use_dropout else (None, None)
-            q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
-            k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
-            v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
-            attn = dot_product_attention(q, k, v, mask=mask)
-            attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
-            if use_dropout:
-                attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
-            h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
-            up = jax.nn.gelu(dot(h, lp["w_up"]) + lp["b_up"])
-            mlp_out = dot(up, lp["w_down"]) + lp["b_down"]
-            if use_dropout:
-                mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
-            h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
-            return h, None
-
-        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-        body = (
-            jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
-            if self.remat_layers
-            else layer
-        )
-        h, _ = jax.lax.scan(body, h, xs)
+            xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+            body = (
+                jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+                if self.remat_layers
+                else layer
+            )
+            h, _ = jax.lax.scan(body, h, xs)
         pooled = jnp.tanh(h[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
         return pooled @ params["classifier"]["w"] + params["classifier"]["b"]
+
+    # -- one encoder layer (shared by apply, streaming, and the pipeline) ----
+
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), kv_mask=None):
+        """One encoder layer. ``kv_mask`` is the raw [B, S] validity mask for
+        ``attention_fn`` implementations (non-causal ring attention)."""
+        cfg = self.config
+        dot = resolve_dot(self.dot_fn)
+        b, s, _ = h.shape
+        nh = cfg.num_heads
+        d = cfg.hidden_size // nh
+        q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
+        k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
+        v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
+        if self.attention_fn is not None:
+            attn = self.attention_fn(q, k, v, kv_mask)
+        else:
+            attn = dot_product_attention(q, k, v, mask=mask)
+        attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
+        if rngs[0] is not None:
+            attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
+        h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+        up = jax.nn.gelu(dot(h, lp["w_up"]) + lp["b_up"])
+        mlp_out = dot(up, lp["w_down"]) + lp["b_down"]
+        if rngs[1] is not None:
+            mlp_out = dropout(mlp_out, cfg.dropout_rate, rngs[1])
+        h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
+        return h
+
+    # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
+
+    def pipeline_layer(self, lp, h, rng, mask, kv_mask):
+        """``layer_fn`` contract: (lp, h, rng, *consts) -> (h, aux)."""
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        h = self._block(h, lp, mask, rngs, kv_mask=kv_mask)
+        return h, jnp.zeros((), jnp.float32)
 
     # -- streaming protocol (big-model dispatch, big_modeling.StreamedModel) --
 
@@ -194,24 +233,10 @@ class Bert:
         return (h, mask)
 
     def stream_layer(self, carry, lp):
-        """One encoder layer; identical math to the scan body in ``apply``
+        """One encoder layer; identical math to the training path — ``_block``
         (including the dot_fn hook, so fp8 dispatch matches fp8 training)."""
-        cfg = self.config
-        dot = resolve_dot(self.dot_fn)
         h, mask = carry
-        b, s, _ = h.shape
-        nh = cfg.num_heads
-        d = cfg.hidden_size // nh
-        q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
-        k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
-        v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
-        attn = dot_product_attention(q, k, v, mask=mask)
-        attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
-        h = layer_norm(h + attn_out, lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
-        up = jax.nn.gelu(dot(h, lp["w_up"]) + lp["b_up"])
-        mlp_out = dot(up, lp["w_down"]) + lp["b_down"]
-        h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
-        return (h, mask)
+        return (self._block(h, lp, mask), mask)
 
     def stream_suffix(self, resident, carry):
         h, _ = carry

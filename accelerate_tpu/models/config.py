@@ -16,7 +16,7 @@ from typing import Optional
 class TransformerConfig:
     """One config for both decoder (llama-style) and encoder (bert-style) stacks."""
 
-    arch: str = "llama"  # "llama" | "bert"
+    arch: str = "llama"  # "llama" | "bert" | "gpt2" | "t5"
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -37,6 +37,11 @@ class TransformerConfig:
     num_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # encoder-decoder (t5) extras: relative-position bias bucketing and the
+    # decoder's BOS (t5 starts generation from the pad token)
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    decoder_start_token_id: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -104,6 +109,35 @@ _REGISTRY: dict[str, TransformerConfig] = {
         arch="gpt2", vocab_size=50257, hidden_size=1600, intermediate_size=6400,
         num_layers=48, num_heads=25, max_seq_len=1024, tie_embeddings=True,
     ),
+    # t5 family (encoder-decoder) — reference examples/inference/t5.py and the
+    # T0pp-11B row of benchmarks/README.md:35. num_layers counts layers PER
+    # stack (encoder and decoder are symmetric); v1.0 geometry (ReLU FF, tied
+    # embeddings with d_model^-0.5 logit scaling).
+    "t5-tiny": TransformerConfig(
+        arch="t5", vocab_size=1024, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, head_dim=32, max_seq_len=256,
+        tie_embeddings=True, rel_buckets=8, rel_max_distance=32,
+    ),
+    "t5-small": TransformerConfig(
+        arch="t5", vocab_size=32128, hidden_size=512, intermediate_size=2048,
+        num_layers=6, num_heads=8, head_dim=64, max_seq_len=512, tie_embeddings=True,
+    ),
+    "t5-base": TransformerConfig(
+        arch="t5", vocab_size=32128, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, head_dim=64, max_seq_len=512, tie_embeddings=True,
+    ),
+    "t5-large": TransformerConfig(
+        arch="t5", vocab_size=32128, hidden_size=1024, intermediate_size=4096,
+        num_layers=24, num_heads=16, head_dim=64, max_seq_len=512, tie_embeddings=True,
+    ),
+    "t5-3b": TransformerConfig(
+        arch="t5", vocab_size=32128, hidden_size=1024, intermediate_size=16384,
+        num_layers=24, num_heads=32, head_dim=128, max_seq_len=512, tie_embeddings=True,
+    ),
+    "t5-11b": TransformerConfig(
+        arch="t5", vocab_size=32128, hidden_size=1024, intermediate_size=65536,
+        num_layers=24, num_heads=128, head_dim=128, max_seq_len=512, tie_embeddings=True,
+    ),
     # bert family (encoder) — nlp_example parity (BERT-base MRPC)
     "bert-tiny": TransformerConfig(
         arch="bert", vocab_size=1024, hidden_size=128, intermediate_size=512,
@@ -164,6 +198,19 @@ def param_count(config: TransformerConfig) -> int:
             + 4 * h               # two layernorms (scale+bias)
         )
         return embed + config.num_layers * per_layer + 2 * h  # + final layernorm
+    if config.arch == "t5":
+        inner = nh * d
+        attn = 4 * h * inner  # q, k, v (h→inner) + o (inner→h): equal byte counts
+        ff = 2 * h * i
+        enc_layer = attn + ff + 2 * h  # two rmsnorms
+        dec_layer = 2 * attn + ff + 3 * h  # self + cross attention, three norms
+        rel = 2 * config.rel_buckets * nh  # one table per stack
+        return (
+            v * h  # shared embedding (tied head)
+            + config.num_layers * (enc_layer + dec_layer)
+            + rel
+            + 2 * h  # encoder + decoder final norms
+        )
     if config.arch == "bert":
         embed = v * h + config.max_seq_len * h + config.type_vocab_size * h + 2 * h
         per_layer = (

@@ -37,6 +37,8 @@ class GPT2:
         # hooks set by Accelerator.prepare_model (see models/llama.py)
         self.remat_layers = False
         self.dot_fn = None
+        self.attention_fn = None  # ring/flash attention for the training path
+        self.pipeline_fn = None  # GPipe layer schedule when the mesh has a pipeline axis
 
     # -- parameters --------------------------------------------------------
 
@@ -95,9 +97,11 @@ class GPT2:
 
     # -- one transformer block (shared by apply, streaming, and KV decode) --
 
-    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), cache=None):
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), cache=None, kv_mask=None):
         """Returns ``h`` (no cache) or ``(h, new_cache)`` when ``cache`` holds
-        {"k","v"} [B, T, N, D] plus the write offset "length"."""
+        {"k","v"} [B, T, N, D] plus the write offset "length". ``kv_mask`` is
+        the raw [B, S] validity mask for ``attention_fn`` implementations
+        (ring/flash attention)."""
         cfg = self.config
         dot = resolve_dot(self.dot_fn)
         b, s, _ = h.shape
@@ -117,6 +121,8 @@ class GPT2:
             )
             attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)
             new_cache = {"k": k_cache, "v": v_cache}
+        elif self.attention_fn is not None:
+            attn = self.attention_fn(q, k, v, kv_mask)
         else:
             attn = dot_product_attention(q, k, v, mask=mask, causal=True)
         attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"]) + lp["bo"]
@@ -196,21 +202,35 @@ class GPT2:
         if use_dropout:
             layer_rngs = jax.random.split(dropout_rng, cfg.num_layers * 2).reshape(cfg.num_layers, 2)
 
-        def layer(h, xs):
-            lp = xs[0] if use_dropout else xs
-            rngs = tuple(xs[1]) if use_dropout else (None, None)
-            h = self._block(h, lp, mask, rngs)
-            return _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None), None
+        if self.pipeline_fn is not None:
+            h, _ = self.pipeline_fn(
+                params["layers"], h, mask, attention_mask,
+                dropout_rng=dropout_rng if use_dropout else None,
+            )
+        else:
+            def layer(h, xs):
+                lp = xs[0] if use_dropout else xs
+                rngs = tuple(xs[1]) if use_dropout else (None, None)
+                h = self._block(h, lp, mask, rngs, kv_mask=attention_mask)
+                return _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None), None
 
-        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-        body = (
-            jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
-            if self.remat_layers
-            else layer
-        )
-        h, _ = jax.lax.scan(body, h, xs)
+            xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+            body = (
+                jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+                if self.remat_layers
+                else layer
+            )
+            h, _ = jax.lax.scan(body, h, xs)
         h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
         return (h @ params["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
+
+    # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
+
+    def pipeline_layer(self, lp, h, rng, mask, kv_mask):
+        """``layer_fn`` contract: (lp, h, rng, *consts) -> (h, aux)."""
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        h = self._block(h, lp, mask, rngs, kv_mask=kv_mask)
+        return h, jnp.zeros((), jnp.float32)
 
     # -- streamed decode protocol (big_modeling.StreamedModel.generate) ------
 

@@ -252,13 +252,15 @@ class Llama:
 
         total_aux = jnp.zeros((), jnp.float32)
         if self.pipeline_fn is not None:
-            if use_dropout:
-                raise NotImplementedError("dropout inside the pipeline schedule is not supported yet")
-            if return_aux and cfg.num_experts > 1:
-                raise NotImplementedError(
-                    "the MoE balance loss is not threaded through the pipeline schedule yet"
-                )
-            h = self.pipeline_fn(params["layers"], h, cos, sin, mask)
+            # dropout rngs fold in per (layer, microbatch) inside the schedule
+            # (pipeline.fold_pipeline_dropout_rng); the MoE balance loss is
+            # accumulated per executed chunk and psum-reduced over the axis.
+            # cos/sin are broadcast consts when batch-invariant (positions
+            # default) and per-microbatch consts for per-row positions.
+            h, total_aux = self.pipeline_fn(
+                params["layers"], h, mask, cos, sin,
+                dropout_rng=dropout_rng if use_dropout else None,
+            )
         else:
             xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
             body = (
@@ -274,6 +276,21 @@ class Llama:
         if return_aux:
             return logits, total_aux
         return logits
+
+    # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
+
+    def pipeline_layer(self, lp, h, rng, mask, cos, sin):
+        """One decoder layer in the pipeline schedule's ``layer_fn`` contract:
+        ``(lp, h, rng, *consts) -> (h, aux)``. ``rng`` is the schedule's
+        per-(layer, microbatch) folded key (None when dropout is off);
+        ``aux`` is the MoE balance loss term (0 for dense layers)."""
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        h, _, aux = decoder_layer(
+            self.config, h, lp, cos, sin, mask, causal=True,
+            dropout_rngs=rngs, dropout_rate=self.config.dropout_rate,
+            dot_fn=self.dot_fn, return_aux=True,
+        )
+        return h, aux
 
     # -- streaming protocol (big_modeling.StreamedModel full-sequence path) --
 

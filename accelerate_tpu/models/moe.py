@@ -171,6 +171,12 @@ def _constrain_expert(value: jax.Array) -> jax.Array:
 
     if not PartialState._shared_state:  # no Accelerator/mesh in this process
         return value
+    if getattr(value.aval, "vma", ()):
+        # inside a shard_map manual region (the pipeline schedule): a
+        # NamedSharding constraint would mix Manual and Auto axis types and
+        # be rejected. The expert layout still holds — GSPMD propagates it
+        # from the moe_up/moe_down parameter shardings.
+        return value
     mesh = PartialState().mesh
     if mesh.shape.get(MESH_AXIS_EXPERT, 1) <= 1:
         return value

@@ -45,7 +45,11 @@ def _interpret() -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale, seq_len):
     iq = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+    # keep q/k/v in their native dtype: the dots accumulate in fp32 via
+    # preferred_element_type, but bf16 OPERANDS run the MXU at full rate —
+    # an fp32 upcast before the dot would quarter the matmul throughput.
+    # Scaling applies to the fp32 scores, not to bf16 q, for precision.
+    q = q_ref[0, 0]  # [BQ, D]
     bq, d = q.shape
 
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -60,11 +64,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale,
 
         def attend(args):
             m, l, acc = args
-            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            s = jax.lax.dot_general(
+            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+            s = scale * jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )  # [BQ, BK]
+            )  # [BQ, BK] fp32
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -72,7 +76,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale,
             correction = jnp.exp(m - m_new)
             l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
             acc_new = acc * correction + jax.lax.dot_general(
-                p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
             return m_new, l_new, acc_new
 
@@ -125,8 +130,9 @@ def _flash_forward(q, k, v, *, block_q, block_k, scale):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_q, block_k, scale, seq_len):
     iq = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
-    do = do_ref[0, 0].astype(jnp.float32)
+    # native-dtype operands on every dot (bf16 MXU rate), fp32 accumulation
+    q = q_ref[0, 0]  # [BQ, D]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0][:, :1]  # [BQ, 1] (sublane-broadcast storage)
     delta = delta_ref[0, 0][:, :1]
     bq, d = q.shape
@@ -136,18 +142,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, b
 
     def body(j, dq):
         def attend(dq):
-            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+            v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
             s = scale * jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-            p = jnp.exp(s - lse)  # [BQ, BK]
+            p = jnp.exp(s - lse)  # [BQ, BK] fp32
             dp = jax.lax.dot_general(
                 do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
-            ds = p * (dp - delta) * scale
+            ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
             return dq + jax.lax.dot_general(
                 ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )
@@ -160,8 +166,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, b
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, block_k, scale, seq_len, group):
     ik = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    # native-dtype operands on every dot (bf16 MXU rate), fp32 accumulation
+    k_blk = k_ref[0, 0]  # [BK, D]
+    v_blk = v_ref[0, 0]
     bk, d = k_blk.shape
 
     k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
@@ -176,23 +183,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
             def attend(carry):
                 dk, dv = carry
-                q = q_ref[0, g, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
-                do = do_ref[0, g, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+                q = q_ref[0, g, pl.ds(jq * block_q, block_q), :]
+                do = do_ref[0, g, pl.ds(jq * block_q, block_q), :]
                 lse = lse_ref[0, g, pl.ds(jq * block_q, block_q), :][:, :1]
                 delta = delta_ref[0, g, pl.ds(jq * block_q, block_q), :][:, :1]
                 s = scale * jax.lax.dot_general(
                     q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-                )  # [BQ, BK]
+                )  # [BQ, BK] fp32
                 q_pos = jq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
                 s = jnp.where(k_pos <= q_pos, s, NEG_INF)
                 p = jnp.exp(s - lse)
                 dv_new = dv + jax.lax.dot_general(
-                    p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+                    p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
                 )
                 dp = jax.lax.dot_general(
                     do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
                 )
-                ds = p * (dp - delta) * scale
+                ds = (p * (dp - delta) * scale).astype(q.dtype)
                 dk_new = dk + jax.lax.dot_general(
                     ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
                 )
@@ -260,22 +268,37 @@ def _flash_backward(res, g, *, block_q, block_k, scale):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention_bnsd(q, k, v, block_q, block_k, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bnsd(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k, scale):
     out, _ = _flash_forward(q, k, v, block_q=block_q, block_k=block_k, scale=scale)
     return out
 
 
-def _fwd_rule(q, k, v, block_q, block_k, scale):
+def _fwd_rule(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k, scale):
     out, lse = _flash_forward(q, k, v, block_q=block_q, block_k=block_k, scale=scale)
+    # named for remat policies: under "save_flash" (the activation-checkpointing
+    # default) the backward keeps out/lse instead of re-running the forward
+    # kernel — q/k/v rebuild from cheap projections, the flash pass does not
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(block_q, block_k, scale, res, g):
-    return _flash_backward(res, g, block_q=block_q, block_k=block_k, scale=scale)
+def _bwd_rule(block_q, block_k, bwd_block_q, bwd_block_k, scale, res, g):
+    return _flash_backward(res, g, block_q=bwd_block_q, block_k=bwd_block_k, scale=scale)
 
 
 _flash_attention_bnsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def _fit_block(block: int, s: int) -> int:
+    """Adapt a block size DOWNWARD (halving, floor 128) until it divides s."""
+    block = min(block, s)
+    while block > 128 and s % block:
+        block //= 2
+    return block
 
 
 def flash_attention(
@@ -285,29 +308,41 @@ def flash_attention(
     kv_mask: Optional[jax.Array] = None,
     block_q: int = 256,
     block_k: int = 512,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
 ) -> jax.Array:
     """Causal flash attention with the ``attention_fn`` hook signature.
 
     Block sizes adapt DOWNWARD (halving, floor 128) until they divide the
     sequence, so any seq that is a multiple of 128 runs the kernel; only a
     padding mask or an untileable length falls back to the einsum path.
+
+    The backward kernels tile independently of the forward (``bwd_block_*``):
+    the dq pass owns a q-block and loops k-blocks, the dkv pass owns a
+    k-block and loops q-blocks, and their best tile shapes differ from the
+    forward's (measured on v5e at seq 4096 — see BWD_BLOCK_Q/BWD_BLOCK_K).
     """
     b, s, n, d = q.shape
-    bq, bk = min(block_q, s), min(block_k, s)
-    while bq > 128 and s % bq:
-        bq //= 2
-    while bk > 128 and s % bk:
-        bk //= 2
-    if kv_mask is not None or bq % 128 or bk % 128 or s % bq or s % bk:
+    bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
+    bbq = _fit_block(bwd_block_q or BWD_BLOCK_Q, s)
+    bbk = _fit_block(bwd_block_k or BWD_BLOCK_K, s)
+    if kv_mask is not None or any(x % 128 or s % x for x in (bq, bk, bbq, bbk)):
         from ..models.attention import dot_product_attention
 
         mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)
         return dot_product_attention(q, k, v, mask=mask, causal=True)
     scale = 1.0 / math.sqrt(d)
     out = _flash_attention_bnsd(
-        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), bq, bk, scale
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), bq, bk, bbq, bbk, scale
     )
     return out.swapaxes(1, 2)
+
+
+# backward tile defaults from the round-4 v5e sweep at seq 4096 (bs=8, 12
+# heads, d=64; fwd fixed at 256/512): (512, 256) 33.9 ms vs the forward's
+# (256, 512) at 34.5 ms; small blocks lose badly (128/128: 60 ms)
+BWD_BLOCK_Q = 512
+BWD_BLOCK_K = 256
 
 
 def make_auto_attention(min_seq: int = 1024):

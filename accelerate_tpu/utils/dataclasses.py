@@ -96,18 +96,41 @@ class InitProcessGroupKwargs(KwargsHandler):
     init_method: Optional[str] = None
     timeout: Optional[timedelta] = None
 
+    _KNOWN_BACKENDS = ("xla", "nccl", "gloo", "mpi", "ccl", "hccl", "ucc", "smddp")
+
+    def __post_init__(self):
+        # Loud validation of the accepted-and-ignored slots: a migrated
+        # positional call like DistributedInitKwargs("host:1234", 4, 0) puts
+        # the coordinator address into `backend` and 0 into `timeout`, then
+        # silently runs single-process. Catch both here.
+        if self.backend is not None and self.backend not in self._KNOWN_BACKENDS:
+            raise ValueError(
+                f"backend={self.backend!r} is not a known process-group backend "
+                f"{self._KNOWN_BACKENDS}. If this is a coordinator address, pass "
+                "it by keyword: DistributedInitKwargs(coordinator_address=...)."
+            )
+        if self.timeout is not None and not isinstance(self.timeout, timedelta):
+            raise TypeError(
+                f"timeout must be a datetime.timedelta, got {type(self.timeout).__name__} "
+                "— positional arguments past (backend, init_method) are not supported."
+            )
+
 
 @dataclass
 class DistributedInitKwargs(InitProcessGroupKwargs):
     """Multi-host bootstrap knobs, fed to jax.distributed.initialize.
 
     Extends :class:`InitProcessGroupKwargs` with the coordinator fields the
-    JAX control plane actually uses (pass them by keyword).
+    JAX control plane actually uses. The coordinator fields are keyword-only:
+    the inherited positional slots are ``(backend, init_method, timeout)``, so
+    a positional ``DistributedInitKwargs("host:1234", 4, 0)`` would silently
+    drop the address into the ignored ``backend`` slot — ``kw_only`` makes
+    that call fail loudly instead.
     """
 
-    coordinator_address: Optional[str] = None
-    num_processes: Optional[int] = None
-    process_id: Optional[int] = None
+    coordinator_address: Optional[str] = field(default=None, kw_only=True)
+    num_processes: Optional[int] = field(default=None, kw_only=True)
+    process_id: Optional[int] = field(default=None, kw_only=True)
 
 
 @dataclass
@@ -332,7 +355,7 @@ class ModelParallelPlugin:
     expert_size: int = 1
     # Extra (regex, PartitionSpec-tuple) rules prepended to the model's own.
     partition_rules: Optional[list[tuple[str, tuple]]] = None
-    num_microbatches: int = 1  # pipeline microbatching
+    num_microbatches: int = 0  # pipeline microbatching; 0 = auto (4 per stage)
     # Megatron interleaved schedule (reference dataclasses.py:1246
     # num_layers_per_virtual_pipeline_stage): chunks per device; shrinks the
     # pipeline bubble ~v-fold at the same microbatch count
@@ -346,7 +369,7 @@ class ModelParallelPlugin:
             sequence_size=parse_int_from_env("ACCELERATE_SEQUENCE_SIZE", 1),
             pipeline_size=parse_int_from_env("ACCELERATE_PIPELINE_SIZE", 1),
             expert_size=parse_int_from_env("ACCELERATE_EXPERT_SIZE", 1),
-            num_microbatches=parse_int_from_env("ACCELERATE_NUM_MICROBATCHES", 1),
+            num_microbatches=parse_int_from_env("ACCELERATE_NUM_MICROBATCHES", 0),
             virtual_pipeline_stages=parse_int_from_env("ACCELERATE_VIRTUAL_PIPELINE_STAGES", 1),
             recompute_activations=parse_flag_from_env("ACCELERATE_RECOMPUTE_ACTIVATIONS", False),
         )
@@ -361,7 +384,7 @@ class CompilationConfig:
     """
 
     donate_params: bool = True
-    remat_policy: Optional[str] = None  # None | "full" | "dots" | "dots_saveable" | "nothing_saveable"
+    remat_policy: Optional[str] = None  # None | "full" | "save_flash" | "dots" | "dots_saveable" | "nothing_saveable"
     use_scan_layers: bool = True  # roll transformer layers into lax.scan (compile-time win)
     # sequences at least this long route causal attention through the Pallas
     # flash kernel (ops/flash_attention.py) on TPU; 0 disables. At seq 1024
@@ -377,6 +400,14 @@ class CompilationConfig:
             None: None,
             "none": None,
             "full": jax.checkpoint_policies.nothing_saveable,
+            # full recompute EXCEPT flash-attention out/lse (named in
+            # ops/flash_attention._fwd_rule): the backward then skips
+            # re-running the flash kernel — at long seq that second forward
+            # pass is the remat's dominant cost. Identical to "full" for
+            # models/paths that never hit the flash kernel (nothing named).
+            "save_flash": jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            ),
             "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
             "dots": jax.checkpoint_policies.checkpoint_dots,
             "dots_saveable": jax.checkpoint_policies.dots_saveable,

@@ -88,3 +88,7 @@ def is_tpu_available() -> bool:
         return any(d.platform == "tpu" for d in jax.devices())
     except RuntimeError:
         return False
+
+
+def is_rich_available() -> bool:
+    return _is_package_available("rich")

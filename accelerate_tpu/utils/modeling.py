@@ -71,6 +71,62 @@ def _iter_flat(tree, prefix=""):
         yield prefix[:-1], tree
 
 
+def find_tied_parameters(tree) -> list[list[str]]:
+    """Groups of pytree paths sharing ONE underlying buffer.
+
+    Reference parity: utils/modeling.py:606-693 ``find_tied_parameters`` walks
+    arbitrary nn.Modules comparing parameter identity. The pytree analogue:
+    two paths are tied when they hold the same array object (a checkpoint
+    loader or user assigned one array to several slots) or numpy views over
+    the same memory. Returns sorted path-groups, largest-first, one per buffer
+    reused at more than one path; [] when nothing is tied (note that
+    *structural* ties — e.g. llama's ``embed_tokens.T`` head — live in the
+    model code, not the param tree, and are invisible here by design).
+    """
+    import collections
+
+    groups: dict[object, list[str]] = collections.defaultdict(list)
+    for key, leaf in _iter_flat(tree):
+        if isinstance(leaf, np.ndarray):
+            # the VIEW's own address + span, not its base buffer's: disjoint
+            # slices of one flat buffer are distinct tensors, while reshape
+            # views (same address, same bytes) are genuinely tied
+            token: object = ("np", leaf.__array_interface__["data"][0], leaf.nbytes)
+        elif hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+            token = ("obj", id(leaf))
+        else:
+            continue
+        groups[token].append(key)
+    tied = [sorted(paths) for paths in groups.values() if len(paths) > 1]
+    return sorted(tied, key=len, reverse=True)
+
+
+def retie_parameters(tree, tied_groups: list[list[str]]):
+    """Point every path of each group at one shared array (reference
+    utils/modeling.py:668 ``retie_parameters``: after a load materializes
+    duplicates, re-establish sharing so the tie survives and memory halves).
+    Mutates and returns ``tree`` (nested mutable mappings)."""
+
+    def _get(path: str):
+        node = tree
+        for part in path.split("/"):
+            node = node[part]
+        return node
+
+    def _set(path: str, value) -> None:
+        node = tree
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node[part]
+        node[parts[-1]] = value
+
+    for group in tied_groups:
+        anchor = _get(group[0])
+        for path in group[1:]:
+            _set(path, anchor)
+    return tree
+
+
 def get_max_memory(max_memory: Optional[dict] = None) -> dict[str, int]:
     """Memory budget per placement target (reference modeling.py:799).
 

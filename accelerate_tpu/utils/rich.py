@@ -1,0 +1,15 @@
+"""Opt-in rich tracebacks (reference utils/rich.py:1-24).
+
+Importing this module installs rich's traceback handler so multi-process
+stack traces are readable; it raises when rich is not installed, exactly like
+the reference (the import IS the opt-in).
+"""
+
+from .imports import is_rich_available
+
+if is_rich_available():
+    from rich.traceback import install
+
+    install(show_locals=False)
+else:
+    raise ModuleNotFoundError("To use the rich extension, install rich with `pip install rich`")

@@ -168,6 +168,21 @@ def test_big_model_inference_example_gpt2(tmp_path):
     assert "tokens:" in out
 
 
+@pytest.mark.parametrize(
+    "script,args",
+    [
+        ("inference/llama.py", ["--model", "llama-tiny", "--tensor", "2", "--max_new_tokens", "4"]),
+        ("inference/gpt2.py", ["--model", "gpt2-tiny", "--tensor", "2", "--max_new_tokens", "4"]),
+        ("inference/bert.py", ["--model", "bert-tiny", "--tensor", "2"]),
+        ("inference/t5.py", ["--model", "t5-tiny", "--tensor", "2", "--max_new_tokens", "4"]),
+    ],
+)
+def test_per_model_inference_examples(script, args):
+    """Per-family walkthroughs (reference examples/inference/{bert,gpt2,llama,t5}.py)."""
+    out = run_example(script, *args)
+    assert "ok" in out
+
+
 def test_distributed_inference_example():
     out = run_example("inference/distributed_inference.py", "--max_new_tokens", "4")
     assert re.search(r"process\(es\) generated 5 sequences", out)

@@ -94,7 +94,9 @@ def test_pipeline_rejects_indivisible_layers():
     state = PartialState(parallelism=ParallelismConfig(pipeline=8))
     cfg = get_config("llama-tiny")  # 2 layers, pipeline 8
     with pytest.raises(ValueError, match="must divide"):
-        make_pipeline_layers_fn(cfg, state.mesh, num_microbatches=4)
+        make_pipeline_layers_fn(
+            cfg, state.mesh, num_microbatches=4, layer_fn=Llama(cfg).pipeline_layer
+        )
 
 def test_pipeline_bf16_full_step_with_tp_fsdp():
     """Regression: bf16 + pipeline (the driver dryrun config) used to crash XLA's
@@ -176,11 +178,15 @@ def test_virtual_stages_grads_match_gpipe():
     cos, sin = rotary_embedding(jnp.arange(8)[None, :], cfg.dim_per_head, cfg.rope_theta)
 
     def loss(layers, fn):
-        return (fn(layers, h, cos, sin, None).astype(jnp.float32) ** 2).mean()
+        out, _ = fn(layers, h, None, cos, sin)
+        return (out.astype(jnp.float32) ** 2).mean()
 
     grads = {}
     for v in (1, 2):
-        fn = make_pipeline_layers_fn(cfg, state.mesh, num_microbatches=4, virtual_stages=v)
+        fn = make_pipeline_layers_fn(
+            cfg, state.mesh, num_microbatches=4,
+            layer_fn=model.pipeline_layer, virtual_stages=v,
+        )
         grads[v] = jax.jit(jax.grad(lambda l: loss(l, fn)))(params["layers"])
     for g1, g2 in zip(jax.tree.leaves(grads[1]), jax.tree.leaves(grads[2])):
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
@@ -214,7 +220,10 @@ def test_virtual_stages_reject_indivisible():
     state = PartialState(parallelism=ParallelismConfig(pipeline=2))
     cfg = get_config("llama-tiny")  # 2 layers: v=2 x P=2 = 4 does not divide
     with pytest.raises(ValueError, match="must divide"):
-        make_pipeline_layers_fn(cfg, state.mesh, num_microbatches=4, virtual_stages=2)
+        make_pipeline_layers_fn(
+            cfg, state.mesh, num_microbatches=4,
+            layer_fn=Llama(cfg).pipeline_layer, virtual_stages=2,
+        )
 
 
 def test_interleaved_schedule_reduces_idle():

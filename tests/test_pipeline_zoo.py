@@ -1,0 +1,252 @@
+"""Pipeline generality across the model zoo (gpt2/bert), dropout-through-
+pipeline, MoE aux loss through pipeline, and per-row positions.
+
+VERDICT r3 items #2 and #5: the schedule must be model-agnostic (reference
+generality analogue: hooks.py:120-176 attach to arbitrary modules) and must
+support standard training regularization (dropout, MoE balance loss).
+"""
+
+import dataclasses
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.models import Bert, GPT2, Llama, get_config
+
+
+def test_gpt2_pipeline_forward_matches_single_device():
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 1024, (8, 16)), jnp.int32)
+    expected = model.apply(params, ids)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    assert model.pipeline_fn is not None
+    got = prepared(ids)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_gpt2_pipeline_with_mask_matches():
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(1))
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 1024, (8, 16)), jnp.int32)
+    am = np.ones((8, 16), np.int32)
+    am[0, :5] = 0
+    am[3, :2] = 0
+    am = jnp.asarray(am)
+    expected = model.apply(params, ids, attention_mask=am)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    got = prepared(ids, attention_mask=am)
+    real = np.asarray(am, bool)
+    np.testing.assert_allclose(np.asarray(expected)[real], np.asarray(got)[real], atol=2e-4)
+
+
+def test_gpt2_pipeline_trains():
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2, data=4))
+    model = GPT2("gpt2-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    loss_fn = GPT2.loss_fn(model)
+    batch = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 1024, (8, 32)), jnp.int32)}
+    losses = []
+    for _ in range(6):
+        with accelerator.accumulate(prepared):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_bert_pipeline_forward_matches_single_device():
+    model = Bert("bert-tiny")
+    params = model.init(jax.random.key(2))
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 1024, (8, 16)), jnp.int32)
+    am = np.ones((8, 16), np.int32)
+    am[1, 10:] = 0
+    am = jnp.asarray(am)
+    expected = model.apply(params, ids, attention_mask=am)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    assert model.pipeline_fn is not None
+    got = prepared(ids, attention_mask=am)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_bert_pipeline_params_sharded_over_pipeline_axis():
+    model = Bert("bert-tiny")
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model)
+    assert prepared.params["layers"]["wq"].sharding.spec[0] == "pipeline"
+    assert prepared.params["layers"]["attn_norm_scale"].sharding.spec[0] == "pipeline"
+
+
+# -- dropout through the pipeline (VERDICT r3 #5) ---------------------------
+
+
+def _dropout_llama(seed=0):
+    cfg = dataclasses.replace(get_config("llama-tiny"), dropout_rate=0.3)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(seed))
+    return model, params
+
+
+def test_llama_pipeline_dropout_matches_fold_reference():
+    """Pipeline forward with dropout == a non-pipeline forward applying the
+    SAME per-(layer, microbatch) rng fold (pipeline.fold_pipeline_dropout_rng)
+    to each microbatch independently."""
+    from accelerate_tpu.models.attention import rotary_embedding
+    from accelerate_tpu.models.llama import decoder_layer, rms_norm
+    from accelerate_tpu.parallel.pipeline import fold_pipeline_dropout_rng
+
+    model, params = _dropout_llama(seed=7)
+    cfg = model.config
+    b, s = 8, 16
+    ids = jnp.asarray(np.random.default_rng(7).integers(0, 1024, (b, s)), jnp.int32)
+    key = jax.random.key(42)
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    num_micro = 4 * 2  # prepare_model default: 4 per stage
+    M_eff = min(num_micro, b)
+    got = model.apply(prepared.params, ids, dropout_rng=key)
+
+    # reference: per-microbatch layer loop with the same fold rule
+    cos, sin = rotary_embedding(jnp.arange(s)[None, :], cfg.dim_per_head, cfg.rope_theta)
+    outs = []
+    for m in range(M_eff):
+        h = jnp.take(params["embed_tokens"], ids[m * (b // M_eff):(m + 1) * (b // M_eff)], axis=0)
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda x: x[l], params["layers"])
+            rngs = tuple(jax.random.split(fold_pipeline_dropout_rng(key, l, m)))
+            h, _ = decoder_layer(
+                cfg, h, lp, cos, sin, None, causal=True,
+                dropout_rngs=rngs, dropout_rate=cfg.dropout_rate,
+            )
+        outs.append(h)
+    h = jnp.concatenate(outs, axis=0)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+    expected = h @ head.astype(h.dtype)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_llama_pipeline_dropout_trains():
+    """A llama with standard training regularization trains under pipeline=2."""
+    cfg = dataclasses.replace(get_config("llama-tiny"), dropout_rate=0.1)
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2, data=4))
+    model = Llama(cfg)
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+
+    def loss_fn(params, batch):
+        logits = model.apply(
+            params, batch["input_ids"], dropout_rng=batch["dropout_rng"]
+        ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = batch["input_ids"][:, 1:]
+        return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+
+    ids = jnp.asarray(np.random.default_rng(8).integers(0, 1024, (8, 32)), jnp.int32)
+    losses = []
+    for i in range(8):
+        batch = {"input_ids": ids, "dropout_rng": jax.random.key(i)}
+        with accelerator.accumulate(prepared):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_pipeline_dropout_runs():
+    """Dropout threads through the schedule for every hooked family."""
+    cfg = dataclasses.replace(get_config("gpt2-tiny"), dropout_rate=0.2)
+    model = GPT2(cfg)
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model)
+    ids = jnp.asarray(np.random.default_rng(9).integers(0, 1024, (8, 16)), jnp.int32)
+    out = model.apply(prepared.params, ids, dropout_rng=jax.random.key(0))
+    assert np.isfinite(np.asarray(out)).all()
+    # dropout must actually fire (different rng -> different logits)
+    out2 = model.apply(prepared.params, ids, dropout_rng=jax.random.key(1))
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+# -- MoE balance loss through the pipeline (VERDICT r3 #5) ------------------
+
+
+def test_moe_aux_threads_through_pipeline_single_microbatch():
+    """With one microbatch the pipeline's per-microbatch aux equals the
+    non-pipeline full-batch aux exactly."""
+    from accelerate_tpu.utils import ModelParallelPlugin
+
+    model = Llama("llama-moe-tiny")
+    params = model.init(jax.random.key(3))
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 1024, (4, 16)), jnp.int32)
+    logits_ref, aux_ref = model.apply(params, ids, return_aux=True)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(
+        parallelism=ParallelismConfig(pipeline=2),
+        model_parallel_plugin=ModelParallelPlugin(pipeline_size=2, num_microbatches=1),
+    )
+    accelerator.prepare_model(model, params=params)
+    assert model.pipeline_fn is not None
+    logits, aux = model.apply(params, ids, return_aux=True)
+    np.testing.assert_allclose(np.asarray(logits_ref), np.asarray(logits), atol=2e-4)
+    np.testing.assert_allclose(float(aux_ref), float(aux), atol=1e-5)
+    assert float(aux) > 0.0  # the balance term is real, not a passthrough zero
+
+
+def test_moe_pipeline_trains_with_balance_loss():
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2, expert=4))
+    model = Llama("llama-moe-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    loss_fn = Llama.loss_fn(model)  # includes the aux term for MoE configs
+    batch = {"input_ids": jnp.asarray(np.random.default_rng(4).integers(0, 1024, (8, 32)), jnp.int32)}
+    losses = []
+    for _ in range(6):
+        with accelerator.accumulate(prepared):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+# -- per-row positions (previously rejected, pipeline.py r3:240) ------------
+
+
+def test_pipeline_per_row_positions_matches():
+    """cos/sin with a real batch dim ride the schedule as per-microbatch side
+    inputs instead of being rejected."""
+    model = Llama("llama-tiny")
+    params = model.init(jax.random.key(5))
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, 1024, (8, 16)), jnp.int32)
+    positions = jnp.asarray(rng.integers(0, 64, (8, 1)), jnp.int32) + jnp.arange(16)[None, :]
+    expected = model.apply(params, ids, positions=positions)
+    model.pipeline_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(pipeline=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    got = model.apply(prepared.params, ids, positions=positions)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)

@@ -108,6 +108,45 @@ def test_sequence_parallel_llama_matches_single_device():
     np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
 
 
+def test_sequence_parallel_gpt2_matches_single_device():
+    """The attention_fn hook is zoo-wide: gpt2 under a sequence axis."""
+    from accelerate_tpu.models import GPT2
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(6).integers(0, 1024, (2, 64)), jnp.int32)
+    expected = model.apply(params, ids)
+    model.attention_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(sequence=4))
+    prepared = accelerator.prepare_model(model, params=params)
+    assert model.attention_fn is not None
+    got = prepared(ids)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_sequence_parallel_bert_matches_single_device():
+    """Bert gets the NON-causal ring (causal_attention=False) — bidirectional
+    attention must survive the sequence axis, padding included."""
+    from accelerate_tpu.models import Bert
+
+    model = Bert("bert-tiny")
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, 1024, (2, 64)), jnp.int32)
+    am = np.ones((2, 64), np.int32)
+    am[1, 48:] = 0
+    am = jnp.asarray(am)
+    expected = model.apply(params, ids, attention_mask=am)
+    model.attention_fn = None
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(sequence=4))
+    prepared = accelerator.prepare_model(model, params=params)
+    assert model.attention_fn is not None
+    got = prepared(ids, attention_mask=am)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
 def test_sequence_parallel_llama_trains():
     accelerator = Accelerator(parallelism=ParallelismConfig(sequence=2, fsdp=2, tensor=2))
     model = Llama("llama-tiny")

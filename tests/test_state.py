@@ -137,6 +137,44 @@ def test_init_process_group_kwargs_reference_positional_order(monkeypatch):
     assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "7"
 
 
+def test_distributed_init_kwargs_after_state_raises(monkeypatch):
+    """Coordinator fields after ANY PartialState exists are dead (the
+    bootstrap is once-only) — must raise, not silently run single-process."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import DistributedInitKwargs
+
+    PartialState()  # bootstrap already ran (single-process)
+    handler = DistributedInitKwargs(
+        coordinator_address="host:1234", num_processes=2, process_id=0
+    )
+    with pytest.raises(ValueError, match="before any"):
+        Accelerator(kwargs_handlers=[handler])
+
+
+def test_timeout_only_kwargs_after_state_is_fine(monkeypatch):
+    """A timeout-only handler stays legal after a PartialState: it only
+    matters if a rendezvous happens later, and the env still reaches it."""
+    import datetime
+    import os
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import InitProcessGroupKwargs
+
+    monkeypatch.setenv("ACCELERATE_INIT_TIMEOUT", "sentinel")
+    monkeypatch.delenv("ACCELERATE_INIT_TIMEOUT")
+    PartialState()
+    Accelerator(kwargs_handlers=[InitProcessGroupKwargs(timeout=datetime.timedelta(seconds=9))])
+    assert os.environ["ACCELERATE_INIT_TIMEOUT"] == "9"
+
+
+def test_distributed_init_kwargs_positional_misuse_raises():
+    """Migrated positional call puts the address into `backend` — loud error."""
+    from accelerate_tpu.utils import DistributedInitKwargs
+
+    with pytest.raises(ValueError, match="coordinator address"):
+        DistributedInitKwargs("host:1234", 4, 0)
+
+
 def test_init_process_group_kwargs_default_timeout_keeps_env(monkeypatch):
     """A handler with no explicit timeout must not clobber an operator-set
     ACCELERATE_INIT_TIMEOUT."""

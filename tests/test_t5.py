@@ -1,0 +1,171 @@
+"""T5 encoder-decoder family: training, TP parity, streaming, decode parity.
+
+VERDICT r3 #3: encoder-decoder coverage (reference examples/inference/t5.py,
+T0pp row of benchmarks/README.md:35).
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, ParallelismConfig, dispatch_model
+from accelerate_tpu.models import T5, build_model
+
+
+def _model_and_params(seed=0):
+    model = T5("t5-tiny")
+    params = model.init(jax.random.key(seed))
+    return model, params
+
+
+def _batch(seed=0, b=4, s_enc=16, s_dec=12):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": jnp.asarray(rng.integers(0, 1024, (b, s_enc)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 1024, (b, s_dec)), jnp.int32),
+    }
+
+
+def test_build_model_registry():
+    model = build_model("t5-tiny")
+    assert model.is_encoder_decoder
+    assert model.config.arch == "t5"
+
+
+def test_shift_right():
+    model, _ = _model_and_params()
+    labels = jnp.asarray([[5, 6, 7]], jnp.int32)
+    shifted = model.shift_right(labels)
+    np.testing.assert_array_equal(np.asarray(shifted), [[0, 5, 6]])
+
+
+def test_t5_trains():
+    accelerator = Accelerator()
+    model = T5("t5-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    loss_fn = T5.loss_fn(model)
+    batch = _batch()
+    losses = []
+    for _ in range(8):
+        with accelerator.accumulate(prepared):
+            loss = accelerator.backward(loss_fn, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_t5_tp_forward_matches_single_device():
+    model, params = _model_and_params(seed=1)
+    batch = _batch(seed=1)
+    dec = model.shift_right(batch["labels"])
+    expected = model.apply(params, batch["input_ids"], dec)
+
+    accelerator = Accelerator(parallelism=ParallelismConfig(tensor=2, fsdp=2))
+    prepared = accelerator.prepare_model(model, params=params)
+    got = prepared(batch["input_ids"], dec)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
+
+
+def test_t5_masked_loss_matches_manual():
+    """Padding on both sides (encoder + decoder) flows through the masks."""
+    model, params = _model_and_params(seed=2)
+    rng = np.random.default_rng(2)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, 1024, (2, 10)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 1024, (2, 6)), jnp.int32),
+        "attention_mask": jnp.asarray([[1] * 10, [1] * 7 + [0] * 3], jnp.int32),
+        "decoder_attention_mask": jnp.asarray([[1] * 6, [1] * 4 + [0] * 2], jnp.int32),
+    }
+    loss = T5.loss_fn(model)(params, batch)
+    assert np.isfinite(float(loss))
+    # padded encoder tokens must not influence the unpadded rows' logits
+    dec = model.shift_right(batch["labels"])
+    full = model.apply(params, batch["input_ids"], dec, batch["attention_mask"])
+    trunc = model.apply(params, batch["input_ids"][1:, :7], dec[1:])
+    np.testing.assert_allclose(
+        np.asarray(full[1]), np.asarray(trunc[0]), atol=2e-4
+    )
+
+
+def test_t5_streamed_call_matches_apply():
+    """Full-sequence streamed forward (decoder stack streamed from host RAM)
+    == the plain apply."""
+    model, params = _model_and_params(seed=3)
+    batch = _batch(seed=3, b=2)
+    dec = model.shift_right(batch["labels"])
+    expected = model.apply(params, batch["input_ids"], dec)
+
+    from accelerate_tpu.big_modeling import make_layered_device_map
+
+    lm = dispatch_model(
+        model, params, device_map=make_layered_device_map(model, "cpu"), dtype=jnp.float32
+    )
+    got = lm(batch["input_ids"], dec)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-3)
+
+
+def test_t5_streamed_generate_matches_full_recompute():
+    """Greedy streamed KV-cache decode == argmax over full re-applies."""
+    model, params = _model_and_params(seed=4)
+    rng = np.random.default_rng(4)
+    enc_ids = jnp.asarray(rng.integers(0, 1024, (2, 12)), jnp.int32)
+    n_new = 6
+
+    # reference: full recompute greedy decode
+    dec = jnp.zeros((2, 1), jnp.int32)  # decoder_start_token_id = 0
+    for _ in range(n_new):
+        logits = model.apply(params, enc_ids, dec)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+
+    from accelerate_tpu.big_modeling import Seq2SeqStreamedModel, make_layered_device_map
+
+    lm = dispatch_model(
+        model, params, device_map=make_layered_device_map(model, "cpu"), dtype=jnp.float32
+    )
+
+    assert isinstance(lm, Seq2SeqStreamedModel)
+    got = lm.generate(enc_ids, max_new_tokens=n_new)
+    np.testing.assert_array_equal(np.asarray(dec), got)
+
+
+def test_t5_streamed_generate_with_encoder_mask():
+    """Padded encoder inputs give the same generation as the truncated ones."""
+    model, params = _model_and_params(seed=5)
+    rng = np.random.default_rng(5)
+    real = jnp.asarray(rng.integers(1, 1024, (1, 9)), jnp.int32)
+    padded = jnp.concatenate([real, jnp.zeros((1, 3), jnp.int32)], axis=1)
+    am = jnp.asarray([[1] * 9 + [0] * 3], jnp.int32)
+
+    from accelerate_tpu.big_modeling import make_layered_device_map
+
+    lm = dispatch_model(
+        model, params, device_map=make_layered_device_map(model, "cpu"), dtype=jnp.float32
+    )
+    out_padded = lm.generate(padded, max_new_tokens=5, attention_mask=am)
+    out_real = lm.generate(real, max_new_tokens=5)
+    np.testing.assert_array_equal(out_padded, out_real)
+
+
+def test_t5_remat_matches():
+    """Activation checkpointing must not change the math."""
+    from accelerate_tpu import FullyShardedDataParallelPlugin
+
+    model, params = _model_and_params(seed=6)
+    batch = _batch(seed=6)
+    dec = model.shift_right(batch["labels"])
+    expected = model.apply(params, batch["input_ids"], dec)
+
+    accelerator = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=True)
+    )
+    prepared = accelerator.prepare_model(model, params=params)
+    assert model.remat_layers
+    got = prepared(batch["input_ids"], dec)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(got), atol=2e-4)
