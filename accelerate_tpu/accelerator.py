@@ -443,6 +443,15 @@ class Accelerator:
             )
         elif hasattr(model, "dot_fn"):
             model.dot_fn = None
+        if not hasattr(model, "pipeline_fn") and self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1:
+            # still mathematically correct (layers replicate over the axis),
+            # but the user asked for pipeline parallelism and gets none — say so
+            logger.warning(
+                f"{type(model).__name__} has no pipeline_fn/pipeline_layer hook: "
+                "the pipeline axis will hold replicated layers (no schedule, no "
+                "memory savings). Implement the hook (models/llama.py) or drop "
+                "the pipeline axis."
+            )
         if hasattr(model, "pipeline_fn"):
             if self.mesh.shape.get(MESH_AXIS_PIPELINE, 1) > 1:
                 from .parallel.pipeline import make_pipeline_layers_fn

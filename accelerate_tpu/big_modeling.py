@@ -790,8 +790,23 @@ def cpu_offload_with_hook(
         lm1(x)          # model1 uploads
         lm2(y)          # model1 evicts first, then model2 uploads
         hook2.offload() # free model2 explicitly
+
+    Construction is HBM-free (reference semantics: the model sits on CPU
+    until its first forward): the dispatched model starts in the EVICTED
+    state with an all-device restore target, so chaining N models never
+    holds more than the executing one resident.
     """
-    dispatched = dispatch_model(model, params, make_layered_device_map(model, "device"), dtype=dtype)
+    from .utils.modeling import named_component_sizes
+
+    # place everything on the host, then mark the whole set as the evicted
+    # image of an all-device placement — the first execution restores it
+    all_cpu = {key: "cpu" for key in named_component_sizes(model)}
+    dispatched = dispatch_model(model, params, all_cpu, dtype=dtype)
+    dispatched._host_shadow = {
+        "resident": dict(dispatched._resident_flat),
+        "layers": {i: buf for i, buf in enumerate(dispatched.layer_buffers)},
+    }
+    dispatched._evicted = True
     dispatched._prev_hook = prev_module_hook
     return dispatched, UserOffloadHook(dispatched)
 

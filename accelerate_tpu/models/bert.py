@@ -174,9 +174,12 @@ class Bert:
 
     # -- one encoder layer (shared by apply, streaming, and the pipeline) ----
 
-    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), kv_mask=None):
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), kv_mask=None, use_attention_hook=True):
         """One encoder layer. ``kv_mask`` is the raw [B, S] validity mask for
-        ``attention_fn`` implementations (non-causal ring attention)."""
+        ``attention_fn`` implementations (non-causal ring attention);
+        ``use_attention_hook=False`` forces the plain masked path — the
+        streaming executor runs single-device with a precomputed 4D mask, and
+        a mesh-bound ring hook left on the model would silently drop it."""
         cfg = self.config
         dot = resolve_dot(self.dot_fn)
         b, s, _ = h.shape
@@ -185,7 +188,7 @@ class Bert:
         q = (dot(h, lp["wq"]) + lp["bq"]).reshape(b, s, nh, d)
         k = (dot(h, lp["wk"]) + lp["bk"]).reshape(b, s, nh, d)
         v = (dot(h, lp["wv"]) + lp["bv"]).reshape(b, s, nh, d)
-        if self.attention_fn is not None:
+        if use_attention_hook and self.attention_fn is not None:
             attn = self.attention_fn(q, k, v, kv_mask)
         else:
             attn = dot_product_attention(q, k, v, mask=mask)
@@ -234,9 +237,11 @@ class Bert:
 
     def stream_layer(self, carry, lp):
         """One encoder layer; identical math to the training path — ``_block``
-        (including the dot_fn hook, so fp8 dispatch matches fp8 training)."""
+        (including the dot_fn hook, so fp8 dispatch matches fp8 training).
+        The mesh-bound attention hook is bypassed: streaming is single-device
+        and the padding mask is already the 4D ``mask`` in the carry."""
         h, mask = carry
-        return (self._block(h, lp, mask), mask)
+        return (self._block(h, lp, mask, use_attention_hook=False), mask)
 
     def stream_suffix(self, resident, carry):
         h, _ = carry

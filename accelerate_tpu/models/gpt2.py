@@ -97,11 +97,12 @@ class GPT2:
 
     # -- one transformer block (shared by apply, streaming, and KV decode) --
 
-    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), cache=None, kv_mask=None):
+    def _block(self, h: jax.Array, lp: dict, mask, rngs=(None, None), cache=None, kv_mask=None, use_attention_hook=True):
         """Returns ``h`` (no cache) or ``(h, new_cache)`` when ``cache`` holds
         {"k","v"} [B, T, N, D] plus the write offset "length". ``kv_mask`` is
         the raw [B, S] validity mask for ``attention_fn`` implementations
-        (ring/flash attention)."""
+        (ring/flash attention); ``use_attention_hook=False`` forces the plain
+        masked path (streaming executor — see models/bert.py)."""
         cfg = self.config
         dot = resolve_dot(self.dot_fn)
         b, s, _ = h.shape
@@ -121,7 +122,7 @@ class GPT2:
             )
             attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)
             new_cache = {"k": k_cache, "v": v_cache}
-        elif self.attention_fn is not None:
+        elif use_attention_hook and self.attention_fn is not None:
             attn = self.attention_fn(q, k, v, kv_mask)
         else:
             attn = dot_product_attention(q, k, v, mask=mask, causal=True)
@@ -291,7 +292,7 @@ class GPT2:
 
     def stream_layer(self, carry, lp):
         h, mask = carry
-        return (self._block(h, lp, mask), mask)
+        return (self._block(h, lp, mask, use_attention_hook=False), mask)
 
     def stream_suffix(self, resident, carry):
         h, _ = carry

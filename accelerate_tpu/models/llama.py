@@ -256,9 +256,10 @@ class Llama:
             # (pipeline.fold_pipeline_dropout_rng); the MoE balance loss is
             # accumulated per executed chunk and psum-reduced over the axis.
             # cos/sin are broadcast consts when batch-invariant (positions
-            # default) and per-microbatch consts for per-row positions.
+            # default) and per-microbatch consts for per-row positions. The
+            # raw [B, S] mask rides along for the flash-attention hook.
             h, total_aux = self.pipeline_fn(
-                params["layers"], h, mask, cos, sin,
+                params["layers"], h, mask, cos, sin, attention_mask,
                 dropout_rng=dropout_rng if use_dropout else None,
             )
         else:
@@ -279,15 +280,19 @@ class Llama:
 
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 
-    def pipeline_layer(self, lp, h, rng, mask, cos, sin):
+    def pipeline_layer(self, lp, h, rng, mask, cos, sin, kv_mask=None):
         """One decoder layer in the pipeline schedule's ``layer_fn`` contract:
         ``(lp, h, rng, *consts) -> (h, aux)``. ``rng`` is the schedule's
         per-(layer, microbatch) folded key (None when dropout is off);
-        ``aux`` is the MoE balance loss term (0 for dense layers)."""
+        ``aux`` is the MoE balance loss term (0 for dense layers). The
+        ``attention_fn`` hook (flash kernel on TPU) applies inside the
+        pipeline too — but never ring attention (sequence axis can't combine
+        with the pipeline, so prepare_model never installs it here)."""
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
         h, _, aux = decoder_layer(
             self.config, h, lp, cos, sin, mask, causal=True,
             dropout_rngs=rngs, dropout_rate=self.config.dropout_rate,
+            attention_fn=self.attention_fn, kv_mask=kv_mask,
             dot_fn=self.dot_fn, return_aux=True,
         )
         return h, aux

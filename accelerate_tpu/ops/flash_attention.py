@@ -38,6 +38,15 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """Out-shape struct inheriting ``like``'s varying-manual-axes type, so the
+    kernel also runs inside shard_map manual regions (the pipeline schedule)."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -115,8 +124,8 @@ def _flash_forward(q, k, v, *, block_q, block_k, scale):
             pl.BlockSpec((1, 1, block_q, 8), lambda bi, ni, qi: (bi, ni, qi, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, n, s, 8), jnp.float32),
+            _sds((b, n, s, d), q.dtype, q),
+            _sds((b, n, s, 8), jnp.float32, q),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -236,7 +245,7 @@ def _flash_backward(res, g, *, block_q, block_k, scale):
         grid=(b, n, s // block_q),
         in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        out_shape=_sds((b, n, s, d), q.dtype, q),
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
 
@@ -255,8 +264,8 @@ def _flash_backward(res, g, *, block_q, block_k, scale):
         in_specs=[qhead_group, kv_blk_spec, kv_blk_spec, qhead_group, rows_group, rows_group],
         out_specs=[kv_blk_spec, kv_blk_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, kv_heads, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b, kv_heads, s, d), v.dtype),
+            _sds((b, kv_heads, s, d), k.dtype, k),
+            _sds((b, kv_heads, s, d), v.dtype, v),
         ],
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
@@ -326,7 +335,15 @@ def flash_attention(
     bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
     bbq = _fit_block(bwd_block_q or BWD_BLOCK_Q, s)
     bbk = _fit_block(bwd_block_k or BWD_BLOCK_K, s)
-    if kv_mask is not None or any(x % 128 or s % x for x in (bq, bk, bbq, bbk)):
+    # interpret-mode pallas inside a shard_map manual region (CPU pipeline
+    # tests) trips a jax hlo_interpreter lowering-cache bug — use the exact
+    # einsum path there; real TPUs lower through Mosaic and keep the kernel
+    in_manual_region = bool(getattr(getattr(q, "aval", None), "vma", None))
+    if (
+        kv_mask is not None
+        or (in_manual_region and _interpret())
+        or any(x % 128 or s % x for x in (bq, bk, bbq, bbk))
+    ):
         from ..models.attention import dot_product_attention
 
         mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)

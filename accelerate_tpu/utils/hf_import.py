@@ -20,6 +20,12 @@ Supports the standard HF repo layout: a single ``model.safetensors``, a
 ``model.safetensors.index.json`` shard index, or a directory holding either.
 ``.npz`` files with the same key naming also work (for installs without
 safetensors).
+
+Covered HF layouts (numerically validated against ``transformers`` forwards
+in tests/test_hf_import_zoo.py): llama (LlamaForCausalLM), gpt2
+(GPT2LMHeadModel — Conv1D [in, out] storage, no transpose), bert
+(BertForSequenceClassification), and t5 (T5ForConditionalGeneration,
+shared-embedding tie + per-stack relative-attention-bias tables).
 """
 
 from __future__ import annotations
@@ -84,7 +90,10 @@ def _load_one(path: str) -> dict[str, np.ndarray]:
 
 
 def looks_like_hf_checkpoint(flat: dict) -> bool:
-    return any(k.startswith("model.") or k == "lm_head.weight" for k in flat)
+    prefixes = ("model.", "transformer.", "bert.", "encoder.block.", "decoder.block.")
+    return any(
+        k.startswith(prefixes) or k in ("lm_head.weight", "shared.weight") for k in flat
+    )
 
 
 def import_hf_llama(
@@ -207,12 +216,199 @@ def export_hf_llama(params: dict, config) -> dict[str, np.ndarray]:
     return flat
 
 
+# ---------------------------------------------------------------------------
+# gpt2 / bert / t5 HF layouts — table-driven translation
+# ---------------------------------------------------------------------------
+
+# torch-name template → (our '/'-joined path with a stacked leading dim,
+# needs_transpose). GPT-2 uses Conv1D modules stored [in, out] — the SAME
+# layout as ours, so nothing transposes; Linear-based models (bert, t5)
+# store [out, in] and transpose on import.
+_HF_GPT2_LAYER_MAP = {
+    "transformer.h.{i}.ln_1.weight": ("layers/attn_norm_scale", False),
+    "transformer.h.{i}.ln_1.bias": ("layers/attn_norm_bias", False),
+    "transformer.h.{i}.attn.c_attn.weight": ("layers/wqkv", False),
+    "transformer.h.{i}.attn.c_attn.bias": ("layers/bqkv", False),
+    "transformer.h.{i}.attn.c_proj.weight": ("layers/wo", False),
+    "transformer.h.{i}.attn.c_proj.bias": ("layers/bo", False),
+    "transformer.h.{i}.ln_2.weight": ("layers/mlp_norm_scale", False),
+    "transformer.h.{i}.ln_2.bias": ("layers/mlp_norm_bias", False),
+    "transformer.h.{i}.mlp.c_fc.weight": ("layers/w_up", False),
+    "transformer.h.{i}.mlp.c_fc.bias": ("layers/b_up", False),
+    "transformer.h.{i}.mlp.c_proj.weight": ("layers/w_down", False),
+    "transformer.h.{i}.mlp.c_proj.bias": ("layers/b_down", False),
+}
+_HF_GPT2_TOP_MAP = {
+    "transformer.wte.weight": ("embed_tokens", False),
+    "transformer.wpe.weight": ("embed_positions", False),
+    "transformer.ln_f.weight": ("final_norm_scale", False),
+    "transformer.ln_f.bias": ("final_norm_bias", False),
+}
+_HF_GPT2_IGNORE = (r"transformer\.h\.\d+\.attn\.(bias|masked_bias)", r"lm_head\.weight")
+
+_HF_BERT_LAYER_MAP = {
+    "bert.encoder.layer.{i}.attention.self.query.weight": ("layers/wq", True),
+    "bert.encoder.layer.{i}.attention.self.query.bias": ("layers/bq", False),
+    "bert.encoder.layer.{i}.attention.self.key.weight": ("layers/wk", True),
+    "bert.encoder.layer.{i}.attention.self.key.bias": ("layers/bk", False),
+    "bert.encoder.layer.{i}.attention.self.value.weight": ("layers/wv", True),
+    "bert.encoder.layer.{i}.attention.self.value.bias": ("layers/bv", False),
+    "bert.encoder.layer.{i}.attention.output.dense.weight": ("layers/wo", True),
+    "bert.encoder.layer.{i}.attention.output.dense.bias": ("layers/bo", False),
+    "bert.encoder.layer.{i}.attention.output.LayerNorm.weight": ("layers/attn_norm_scale", False),
+    "bert.encoder.layer.{i}.attention.output.LayerNorm.bias": ("layers/attn_norm_bias", False),
+    "bert.encoder.layer.{i}.intermediate.dense.weight": ("layers/w_up", True),
+    "bert.encoder.layer.{i}.intermediate.dense.bias": ("layers/b_up", False),
+    "bert.encoder.layer.{i}.output.dense.weight": ("layers/w_down", True),
+    "bert.encoder.layer.{i}.output.dense.bias": ("layers/b_down", False),
+    "bert.encoder.layer.{i}.output.LayerNorm.weight": ("layers/mlp_norm_scale", False),
+    "bert.encoder.layer.{i}.output.LayerNorm.bias": ("layers/mlp_norm_bias", False),
+}
+_HF_BERT_TOP_MAP = {
+    "bert.embeddings.word_embeddings.weight": ("embeddings/word", False),
+    "bert.embeddings.position_embeddings.weight": ("embeddings/position", False),
+    "bert.embeddings.token_type_embeddings.weight": ("embeddings/token_type", False),
+    "bert.embeddings.LayerNorm.weight": ("embeddings/norm_scale", False),
+    "bert.embeddings.LayerNorm.bias": ("embeddings/norm_bias", False),
+    "bert.pooler.dense.weight": ("pooler/w", True),
+    "bert.pooler.dense.bias": ("pooler/b", False),
+    "classifier.weight": ("classifier/w", True),
+    "classifier.bias": ("classifier/b", False),
+}
+_HF_BERT_IGNORE = (r"bert\.embeddings\.position_ids", r"cls\..*")
+
+_HF_T5_LAYER_MAP = {
+    "encoder.block.{i}.layer.0.SelfAttention.q.weight": ("encoder/wq", True),
+    "encoder.block.{i}.layer.0.SelfAttention.k.weight": ("encoder/wk", True),
+    "encoder.block.{i}.layer.0.SelfAttention.v.weight": ("encoder/wv", True),
+    "encoder.block.{i}.layer.0.SelfAttention.o.weight": ("encoder/wo", True),
+    "encoder.block.{i}.layer.0.layer_norm.weight": ("encoder/attn_norm", False),
+    "encoder.block.{i}.layer.1.DenseReluDense.wi.weight": ("encoder/wi", True),
+    "encoder.block.{i}.layer.1.DenseReluDense.wo.weight": ("encoder/wo_ff", True),
+    "encoder.block.{i}.layer.1.layer_norm.weight": ("encoder/mlp_norm", False),
+    "decoder.block.{i}.layer.0.SelfAttention.q.weight": ("layers/self_wq", True),
+    "decoder.block.{i}.layer.0.SelfAttention.k.weight": ("layers/self_wk", True),
+    "decoder.block.{i}.layer.0.SelfAttention.v.weight": ("layers/self_wv", True),
+    "decoder.block.{i}.layer.0.SelfAttention.o.weight": ("layers/self_wo", True),
+    "decoder.block.{i}.layer.0.layer_norm.weight": ("layers/self_norm", False),
+    "decoder.block.{i}.layer.1.EncDecAttention.q.weight": ("layers/cross_wq", True),
+    "decoder.block.{i}.layer.1.EncDecAttention.k.weight": ("layers/cross_wk", True),
+    "decoder.block.{i}.layer.1.EncDecAttention.v.weight": ("layers/cross_wv", True),
+    "decoder.block.{i}.layer.1.EncDecAttention.o.weight": ("layers/cross_wo", True),
+    "decoder.block.{i}.layer.1.layer_norm.weight": ("layers/cross_norm", False),
+    "decoder.block.{i}.layer.2.DenseReluDense.wi.weight": ("layers/wi", True),
+    "decoder.block.{i}.layer.2.DenseReluDense.wo.weight": ("layers/wo_ff", True),
+    "decoder.block.{i}.layer.2.layer_norm.weight": ("layers/mlp_norm", False),
+}
+_HF_T5_TOP_MAP = {
+    "shared.weight": ("shared_embed", False),
+    "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": ("enc_rel_bias", False),
+    "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": ("dec_rel_bias", False),
+    "encoder.final_layer_norm.weight": ("enc_final_norm", False),
+    "decoder.final_layer_norm.weight": ("dec_final_norm", False),
+}
+_HF_T5_IGNORE = (
+    r"(encoder|decoder)\.embed_tokens\.weight",  # alias of shared.weight
+    r"lm_head\.weight",  # tied (t5 v1.0)
+)
+
+_HF_FAMILY_TABLES = {
+    "gpt2": (_HF_GPT2_LAYER_MAP, _HF_GPT2_TOP_MAP, _HF_GPT2_IGNORE),
+    "bert": (_HF_BERT_LAYER_MAP, _HF_BERT_TOP_MAP, _HF_BERT_IGNORE),
+    "t5": (_HF_T5_LAYER_MAP, _HF_T5_TOP_MAP, _HF_T5_IGNORE),
+}
+
+
+def _set_path(tree: dict, path: str, value) -> None:
+    node = tree
+    parts = path.split("/")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def import_hf_family(flat: dict[str, np.ndarray], config, dtype: Optional[Any] = None) -> dict:
+    """Table-driven HF-layout translation for gpt2/bert/t5 (llama has its own
+    tie-aware importer, :func:`import_hf_llama`). Shapes are validated against
+    the model's abstract init so a wrong-config import fails loudly."""
+    layer_map, top_map, ignore = _HF_FAMILY_TABLES[config.arch]
+    L = config.num_layers
+    consumed: set[str] = set()
+
+    def take(name: str, transpose: bool) -> np.ndarray:
+        if name not in flat:
+            raise KeyError(f"HF checkpoint is missing {name!r}")
+        consumed.add(name)
+        value = np.asarray(flat[name])
+        return value.T if transpose else value
+
+    params: dict[str, Any] = {}
+    for torch_name, (ours, transpose) in top_map.items():
+        _set_path(params, ours, take(torch_name, transpose))
+    for torch_tpl, (ours, transpose) in layer_map.items():
+        stacked = np.stack([take(torch_tpl.format(i=i), transpose) for i in range(L)])
+        _set_path(params, ours, stacked)
+
+    unused = {
+        k for k in set(flat) - consumed if not any(re.fullmatch(p, k) for p in ignore)
+    }
+    if unused:
+        logger.warning(f"Ignoring {len(unused)} unused checkpoint tensors: {sorted(unused)[:5]}...")
+
+    # validate against the abstract param tree (exact and allocation-free)
+    import jax
+
+    from ..models import _ARCHS
+    from .modeling import _iter_flat
+
+    abstract = jax.eval_shape(_ARCHS[config.arch](config).init, jax.random.key(0))
+    flat_abstract = {k: tuple(v.shape) for k, v in _iter_flat(abstract)}
+    flat_params = {k: tuple(v.shape) for k, v in _iter_flat(params)}
+    if flat_abstract.keys() != flat_params.keys():
+        missing = sorted(flat_abstract.keys() - flat_params.keys())
+        extra = sorted(flat_params.keys() - flat_abstract.keys())
+        raise KeyError(f"HF import tree mismatch: missing {missing[:5]}, extra {extra[:5]}")
+    for key, shape in flat_abstract.items():
+        if flat_params[key] != shape:
+            raise ValueError(f"{key}: checkpoint shape {flat_params[key]} != config shape {shape}")
+
+    if dtype is not None:
+        np_dtype = np.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+        params = _tree_astype(params, np_dtype)
+    return params
+
+
+def export_hf_family(params: dict, config) -> dict[str, np.ndarray]:
+    """Inverse of :func:`import_hf_family`: our tree → HF torch naming."""
+    layer_map, top_map, _ = _HF_FAMILY_TABLES[config.arch]
+
+    def get(path: str):
+        node = params
+        for part in path.split("/"):
+            node = node[part]
+        return np.asarray(node)
+
+    flat: dict[str, np.ndarray] = {}
+    for torch_name, (ours, transpose) in top_map.items():
+        value = get(ours)
+        flat[torch_name] = value.T if transpose else value
+    for torch_tpl, (ours, transpose) in layer_map.items():
+        stacked = get(ours)
+        for i in range(config.num_layers):
+            value = stacked[i]
+            flat[torch_tpl.format(i=i)] = value.T if transpose else value
+    return flat
+
+
 def load_checkpoint_in_model(model, checkpoint_path: str, dtype=None) -> dict:
     """Reference load_checkpoint_in_model (utils/modeling.py:1541) for our
     models: reads an HF-layout OR native-layout checkpoint and returns the
     param tree (numpy leaves, ready for shard_tree/device_put)."""
     flat = load_hf_state_dict(checkpoint_path)
     if looks_like_hf_checkpoint(flat):
+        arch = getattr(model.config, "arch", "llama")
+        if arch in _HF_FAMILY_TABLES:
+            return import_hf_family(flat, model.config, dtype=dtype)
         return import_hf_llama(flat, model.config, dtype=dtype)
     # native flat layout ("embed_tokens", "layers/wq", ...): unflatten by path
     # against the abstract tree, keeping numpy leaves (no device allocation —

@@ -42,21 +42,29 @@ def named_component_sizes(
     or 0.5 (int4) bytes/weight while embed/head stay at the compute dtype.
     (The quantized fp32 scale sidecar is ~1/hidden of the weights — ignored.)
     """
-    cfg: TransformerConfig = model.config
     if layer_dtype_bytes is None:
         layer_dtype_bytes = dtype_bytes
     shapes = jax.eval_shape(model.init, jax.random.key(0))
     sizes: dict[str, int] = {}
     layer_total = 0
+    num_layers = 0
     for key, leaf in _iter_flat(shapes):
         count = int(np.prod(leaf.shape))
         if key.startswith("layers/"):
             layer_total += int(count * layer_dtype_bytes)
+            # stacked layout: every layers/* leaf is [L, ...] — the stack
+            # depth comes from the tree itself, so arbitrary (non-registry)
+            # models with a `layers` stack size correctly too
+            num_layers = max(num_layers, int(leaf.shape[0]))
         else:
             sizes[key.replace("/", ".")] = int(count * dtype_bytes)
-    per_layer = layer_total // cfg.num_layers
-    for i in range(cfg.num_layers):
-        sizes[f"layers.{i}"] = per_layer
+    cfg: Optional[TransformerConfig] = getattr(model, "config", None)
+    if cfg is not None and getattr(cfg, "num_layers", None):
+        num_layers = cfg.num_layers
+    if num_layers:
+        per_layer = layer_total // num_layers
+        for i in range(num_layers):
+            sizes[f"layers.{i}"] = per_layer
     return sizes
 
 

@@ -114,3 +114,14 @@ def reset_accelerator_state():
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+
+
+def cap_parallel_degree(degree: int) -> int:
+    """Clamp a requested parallel degree to the visible topology (walkthroughs
+    still run on a single chip; on an 8-device mesh they shard for real)."""
+    import jax
+
+    n = jax.device_count()
+    while degree > 1 and n % degree:
+        degree -= 1
+    return min(degree, n)

@@ -13,6 +13,7 @@ Run:
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import numpy as np
@@ -25,14 +26,10 @@ from accelerate_tpu.big_modeling import make_layered_device_map
 from accelerate_tpu.models import build_model
 from accelerate_tpu.utils import set_seed
 
+import sys as _sys
 
-def _cap(degree: int) -> int:
-    """Clamp a parallel degree to the visible topology (the walkthrough still
-    runs on a single chip; on an 8-device mesh it shards for real)."""
-    n = jax.device_count()
-    while degree > 1 and n % degree:
-        degree -= 1
-    return min(degree, n)
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import cap_parallel_degree as _cap
 
 
 def main(argv=None):

@@ -1,0 +1,77 @@
+"""cloud-launch command assembly (the reference SageMaker launcher analogue,
+commands/launch.py:871-888 — submission into a managed cloud fleet)."""
+
+import argparse
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands.cloud import (
+    delete_command,
+    plan,
+    provision_command,
+    run,
+    train_command,
+)
+
+
+def _args(**over):
+    base = dict(
+        tpu_name="trainer", zone="us-central2-b", accelerator_type="v5litepod-8",
+        runtime_version="tpu-ubuntu2204-base", project=None, queued=False,
+        spot=False, setup_cmd=None, env=[], delete_after=False, debug=True,
+        mixed_precision=None, training_script="train.py", training_script_args=[],
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+def test_direct_plan_creates_pushes_runs():
+    steps = plan(_args())
+    assert steps[0][:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "scp" in steps[1]
+    assert any("accelerate-tpu launch" in part for part in steps[2])
+    assert len(steps) == 3  # no delete without --delete_after
+
+
+def test_queued_plan_waits_and_deletes():
+    steps = plan(_args(queued=True, delete_after=True, spot=True))
+    assert steps[0][1] == "alpha" and "queued-resources" in steps[0]
+    assert "--spot" in steps[0]
+    assert "describe" in steps[1]  # capacity wait
+    assert "delete" in steps[-1] and "queued-resources" in steps[-1]
+
+
+def test_train_command_env_and_args():
+    cmd = train_command(_args(
+        env=["WANDB_MODE=offline"], mixed_precision="bf16",
+        training_script_args=["--epochs", "3"],
+    ))
+    remote = cmd[-1]
+    assert "export WANDB_MODE=offline" in remote
+    assert "--mixed_precision bf16" in remote
+    assert "~/train.py --epochs 3" in remote
+    assert "--worker=all" in cmd
+
+
+def test_rejects_non_python_script():
+    with pytest.raises(ValueError, match="python training script"):
+        run(_args(training_script="train.sh"))
+
+
+def test_env_validation():
+    with pytest.raises(ValueError, match="KEY=VALUE"):
+        train_command(_args(env=["BROKEN"]))
+
+
+def test_cli_debug_prints_plan():
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "cloud-launch",
+         "--tpu_name", "t", "--zone", "z", "--debug", "--delete_after", "train.py"],
+        capture_output=True, text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    lines = result.stdout.strip().splitlines()
+    assert lines[0].startswith("gcloud compute tpus tpu-vm create")
+    assert "delete" in lines[-1]
