@@ -474,8 +474,18 @@ class Accelerator:
                     model.config, self.mesh, num_micro,
                     layer_fn=model.pipeline_layer, virtual_stages=virtual,
                 )
+                if hasattr(model, "enc_pipeline_layer"):
+                    # encoder-decoder models pipeline each stack separately
+                    # (t5: the encoder schedule completes, then the decoder
+                    # schedule runs with enc_out as a per-microbatch input)
+                    model.enc_pipeline_fn = make_pipeline_layers_fn(
+                        model.config, self.mesh, num_micro,
+                        layer_fn=model.enc_pipeline_layer, virtual_stages=virtual,
+                    )
             else:
                 model.pipeline_fn = None
+                if hasattr(model, "enc_pipeline_fn"):
+                    model.enc_pipeline_fn = None
         layer_policy = self.compilation_config.checkpoint_policy()
         if hasattr(model, "remat_layers"):
             # scan-structured models apply the remat policy per layer (the

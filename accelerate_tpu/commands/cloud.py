@@ -144,10 +144,12 @@ def train_command(args) -> list[str]:
         launch += f" --mixed_precision {args.mixed_precision}"
     script_args = " ".join(shlex.quote(a) for a in args.training_script_args)
     parts.append(f"{launch} {remote} {script_args}".rstrip())
+    # '&&': a failed setup step must abort (and surface through ssh's exit
+    # code) instead of training against a broken environment
     return _with_project(args, [
         "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
         f"--zone={args.zone}", "--worker=all",
-        f"--command={'; '.join(parts)}",
+        f"--command={' && '.join(parts)}",
     ])
 
 
@@ -187,7 +189,7 @@ def run(args) -> int:
         )
     import time
 
-    for cmd in steps:
+    def _execute(cmd: list[str]) -> None:
         if args.queued and "describe" in cmd:
             # poll the queued resource until ACTIVE (capacity granted);
             # bounded by --provision_timeout, and a persistently failing
@@ -208,7 +210,7 @@ def run(args) -> int:
                     state = result.stdout.strip()
                     print(f"queued-resource state: {state or 'PENDING'}")
                     if state == "ACTIVE":
-                        break
+                        return
                     if state in ("FAILED", "SUSPENDED"):
                         raise RuntimeError(f"queued resource entered {state}")
                 if time.monotonic() > deadline:
@@ -217,9 +219,19 @@ def run(args) -> int:
                         "raise --provision_timeout or delete the request"
                     )
                 time.sleep(30)
-            continue
         print("+", " ".join(shlex.quote(c) for c in cmd))
         result = subprocess.run(cmd)
         if result.returncode != 0:
             raise RuntimeError(f"command failed with {result.returncode}: {cmd[0]} {cmd[1] if len(cmd) > 1 else ''}")
+
+    # teardown is job semantics: once provisioning was ATTEMPTED, a failure
+    # anywhere later must not strand a billed slice — run the delete step in
+    # a finally when --delete_after is set
+    teardown = steps.pop() if args.delete_after else None
+    try:
+        for cmd in steps:
+            _execute(cmd)
+    finally:
+        if teardown is not None:
+            _execute(teardown)
     return 0

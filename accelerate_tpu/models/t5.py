@@ -96,9 +96,14 @@ class T5:
     def __init__(self, config: TransformerConfig | str):
         self.config = get_config(config) if isinstance(config, str) else config
         assert self.config.arch == "t5"
-        # hooks set by Accelerator.prepare_model (see models/llama.py)
+        # hooks set by Accelerator.prepare_model (see models/llama.py).
+        # The two stacks pipeline separately: the encoder schedule runs to
+        # completion, then the decoder schedule runs with the encoder output
+        # riding along as a per-microbatch side input (cross-attention).
         self.remat_layers = False
         self.dot_fn = None
+        self.pipeline_fn = None  # decoder stack (params["layers"])
+        self.enc_pipeline_fn = None  # encoder stack (params["encoder"])
 
     # -- parameters --------------------------------------------------------
 
@@ -152,15 +157,20 @@ class T5:
 
     def partition_rules(self) -> list[tuple[str, tuple]]:
         """Megatron TP: q/k/v/wi column-parallel, output projections
-        row-parallel; the relative-bias tables replicate (tiny)."""
+        row-parallel; the relative-bias tables replicate (tiny). Stacked
+        leading dims shard over the pipeline axis (size-1 = no-op)."""
+        from ..utils.constants import MESH_AXIS_PIPELINE
+
         t = MESH_AXIS_TENSOR
+        p = MESH_AXIS_PIPELINE
         return [
             (r"shared_embed", (t, None)),
             (r"rel_bias", (None, None)),
-            (r"(encoder|layers)/.*w[qkv]$", (None, None, t)),
-            (r"(encoder|layers)/.*wo$", (None, t, None)),
-            (r"(encoder|layers)/wi", (None, None, t)),
-            (r"(encoder|layers)/wo_ff", (None, t, None)),
+            (r"(encoder|layers)/.*w[qkv]$", (p, None, t)),
+            (r"(encoder|layers)/.*wo$", (p, t, None)),
+            (r"(encoder|layers)/wi", (p, None, t)),
+            (r"(encoder|layers)/wo_ff", (p, t, None)),
+            (r"(encoder|layers)/.*norm", (p, None)),
             (r"norm", (None,)),
         ]
 
@@ -257,6 +267,12 @@ class T5:
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
         use_dropout = dropout_rng is not None and cfg.dropout_rate > 0.0
+        if self.enc_pipeline_fn is not None:
+            h, _ = self.enc_pipeline_fn(
+                params["encoder"], h, mask, bias,
+                dropout_rng=dropout_rng if use_dropout else None,
+            )
+            return rms_norm(h, params["enc_final_norm"], cfg.norm_eps)
         if use_dropout:
             layer_rngs = jax.random.split(dropout_rng, cfg.num_layers * 2).reshape(cfg.num_layers, 2)
 
@@ -308,24 +324,47 @@ class T5:
         enc_mask = None
         if attention_mask is not None:
             enc_mask = attention_mask[:, None, None, :].astype(bool)
-        if use_dropout:
-            layer_rngs = jax.random.split(dec_rng, cfg.num_layers * 3).reshape(cfg.num_layers, 3)
+        if self.pipeline_fn is not None:
+            # enc_out/enc_mask/self_mask are per-microbatch side inputs
+            # (leading dim == batch); self_bias is batch-invariant broadcast
+            h, _ = self.pipeline_fn(
+                params["layers"], h, self_bias, self_mask, enc_out, enc_mask,
+                dropout_rng=dec_rng if use_dropout else None,
+            )
+        else:
+            if use_dropout:
+                layer_rngs = jax.random.split(dec_rng, cfg.num_layers * 3).reshape(cfg.num_layers, 3)
 
-        def layer(h, xs):
-            lp = xs[0] if use_dropout else xs
-            rngs = tuple(xs[1]) if use_dropout else (None, None, None)
-            h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, rngs)
-            return _constrain(h, BATCH_AXES, None, None), None
+            def layer(h, xs):
+                lp = xs[0] if use_dropout else xs
+                rngs = tuple(xs[1]) if use_dropout else (None, None, None)
+                h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, rngs)
+                return _constrain(h, BATCH_AXES, None, None), None
 
-        xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
-        body = (
-            jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
-            if self.remat_layers
-            else layer
-        )
-        h, _ = jax.lax.scan(body, h, xs)
+            xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
+            body = (
+                jax.checkpoint(layer, policy=self.remat_layers if callable(self.remat_layers) else None)
+                if self.remat_layers
+                else layer
+            )
+            h, _ = jax.lax.scan(body, h, xs)
         h = rms_norm(h, params["dec_final_norm"], cfg.norm_eps)
         return self._lm_logits(params, h)
+
+    # -- pipeline hooks (parallel/pipeline.make_pipeline_layers_fn) ----------
+
+    def enc_pipeline_layer(self, lp, h, rng, mask, bias):
+        """Encoder-stack ``layer_fn``: (lp, h, rng, *consts) -> (h, aux)."""
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        h = self._enc_layer(h, lp, bias, mask, rngs)
+        return h, jnp.zeros((), jnp.float32)
+
+    def pipeline_layer(self, lp, h, rng, self_bias, self_mask, enc_out, enc_mask):
+        """Decoder-stack ``layer_fn``: cross-attention reads the encoder
+        output carried as a per-microbatch side input."""
+        rngs = (None, None, None) if rng is None else tuple(jax.random.split(rng, 3))
+        h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, rngs)
+        return h, jnp.zeros((), jnp.float32)
 
     def _lm_logits(self, params, h):
         # tied head with the T5 d_model^-0.5 rescale (the paper folds the

@@ -309,7 +309,7 @@ _HF_T5_TOP_MAP = {
 }
 _HF_T5_IGNORE = (
     r"(encoder|decoder)\.embed_tokens\.weight",  # alias of shared.weight
-    r"lm_head\.weight",  # tied (t5 v1.0)
+    r"lm_head\.weight",  # tied copy only — untied heads raise (see below)
 )
 
 _HF_FAMILY_TABLES = {
@@ -348,6 +348,21 @@ def import_hf_family(flat: dict[str, np.ndarray], config, dtype: Optional[Any] =
     for torch_tpl, (ours, transpose) in layer_map.items():
         stacked = np.stack([take(torch_tpl.format(i=i), transpose) for i in range(L)])
         _set_path(params, ours, stacked)
+
+    if config.arch == "t5" and "lm_head.weight" in flat:
+        # our T5 is tied (logits = shared_embed.T with the d_model^-0.5
+        # rescale). A checkpoint whose lm_head DIFFERS from the shared
+        # embedding (tie_word_embeddings=False fine-tunes) would silently
+        # produce wrong logits — refuse it; an equal copy is just the
+        # serialized tie and drops harmlessly.
+        head = np.asarray(flat["lm_head.weight"])
+        if not np.array_equal(head, np.asarray(flat["shared.weight"])):
+            raise ValueError(
+                "HF t5 checkpoint carries an UNTIED lm_head.weight "
+                "(tie_word_embeddings=False); this T5 family computes logits "
+                "from the shared embedding — untied-head checkpoints are not "
+                "supported."
+            )
 
     unused = {
         k for k in set(flat) - consumed if not any(re.fullmatch(p, k) for p in ignore)

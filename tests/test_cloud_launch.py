@@ -75,3 +75,32 @@ def test_cli_debug_prints_plan():
     lines = result.stdout.strip().splitlines()
     assert lines[0].startswith("gcloud compute tpus tpu-vm create")
     assert "delete" in lines[-1]
+
+
+def test_delete_after_runs_on_failure(monkeypatch):
+    """--delete_after is job semantics: teardown runs even when a step fails
+    (a stranded slice keeps billing)."""
+    import accelerate_tpu.commands.cloud as cloud
+
+    calls = []
+
+    class R:
+        def __init__(self, rc):
+            self.returncode = rc
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        # provision ok, scp FAILS, delete must still run
+        return R(1 if "scp" in cmd else 0)
+
+    monkeypatch.setattr(cloud.subprocess, "run", fake_run)
+    monkeypatch.setattr(cloud.shutil, "which", lambda name: "/usr/bin/gcloud")
+    args = _args(debug=False, delete_after=True)
+    with pytest.raises(RuntimeError, match="command failed"):
+        run(args)
+    assert any("delete" in c for c in calls), calls
+
+
+def test_train_command_joins_with_and():
+    cmd = train_command(_args(setup_cmd="pip install -e .", env=["A=1"]))
+    assert " && " in cmd[-1] and "; " not in cmd[-1]

@@ -128,3 +128,13 @@ def test_load_checkpoint_in_model_routes_by_arch(tmp_path):
     dec = jnp.asarray([[0, 5]], jnp.int32)
     out = model.apply(params, enc, dec)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_t5_untied_lm_head_raises():
+    """tie_word_embeddings=False checkpoints must fail loudly, not produce
+    silently wrong logits from the tied path (review repro)."""
+    hf = _hf_t5()
+    sd = _state_dict(hf)
+    sd["lm_head.weight"] = np.random.default_rng(0).normal(size=sd["shared.weight"].shape).astype(np.float32)
+    with pytest.raises(ValueError, match="UNTIED"):
+        import_hf_family(sd, get_config("t5-tiny"))
