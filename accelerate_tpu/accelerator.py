@@ -469,10 +469,23 @@ class Accelerator:
                     else 1
                 )
                 # the model's own per-layer function drives the schedule
-                # (reads self.dot_fn at trace time, so fp8 stays wired)
+                # (reads self.dot_fn at trace time, so fp8 stays wired).
+                # With a sequence axis the schedule goes manual over BOTH
+                # axes (the model declares its sequence dims) and the layers
+                # must use the manual-region ring attention.
+                seq_dims = None
+                if self.mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
+                    seq_dims = getattr(model, "pipeline_seq_dims", None)
+                    if hasattr(model, "attention_fn"):
+                        from .parallel.ring_attention import make_local_ring_attention
+
+                        model.attention_fn = make_local_ring_attention(
+                            causal=getattr(model, "causal_attention", True)
+                        )
                 model.pipeline_fn = make_pipeline_layers_fn(
                     model.config, self.mesh, num_micro,
                     layer_fn=model.pipeline_layer, virtual_stages=virtual,
+                    seq_dims=seq_dims,
                 )
                 if hasattr(model, "enc_pipeline_layer"):
                     # encoder-decoder models pipeline each stack separately

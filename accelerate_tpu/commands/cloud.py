@@ -226,12 +226,21 @@ def run(args) -> int:
 
     # teardown is job semantics: once provisioning was ATTEMPTED, a failure
     # anywhere later must not strand a billed slice — run the delete step in
-    # a finally when --delete_after is set
+    # a finally when --delete_after is set. A teardown failure must not
+    # SHADOW the original error (e.g. a quota failure followed by deleting a
+    # slice that was never created) — the first failure stays the reported one.
     teardown = steps.pop() if args.delete_after else None
+    job_ok = False
     try:
         for cmd in steps:
             _execute(cmd)
+        job_ok = True
     finally:
         if teardown is not None:
-            _execute(teardown)
+            try:
+                _execute(teardown)
+            except Exception as e:
+                if job_ok:
+                    raise
+                print(f"teardown also failed (original error follows): {e}")
     return 0

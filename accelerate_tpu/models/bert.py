@@ -203,6 +203,9 @@ class Bert:
         h = layer_norm(h + mlp_out, lp["mlp_norm_scale"], lp["mlp_norm_bias"], cfg.norm_eps)
         return h
 
+    # sequence dims of the pipeline activations/side inputs (mask, kv_mask)
+    pipeline_seq_dims = {"h": 1, "consts": (3, 1)}
+
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 
     def pipeline_layer(self, lp, h, rng, mask, kv_mask):

@@ -225,6 +225,9 @@ class GPT2:
         h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], cfg.norm_eps)
         return (h @ params["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
 
+    # sequence dims of the pipeline activations/side inputs (mask, kv_mask)
+    pipeline_seq_dims = {"h": 1, "consts": (3, 1)}
+
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 
     def pipeline_layer(self, lp, h, rng, mask, kv_mask):

@@ -278,6 +278,11 @@ class Llama:
             return logits, total_aux
         return logits
 
+    # sequence dimension of the pipeline activations and side inputs
+    # (mask, cos, sin, kv_mask) — lets the schedule combine with a sequence
+    # axis (ring attention inside each stage)
+    pipeline_seq_dims = {"h": 1, "consts": (3, 1, 1, 1)}
+
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 
     def pipeline_layer(self, lp, h, rng, mask, cos, sin, kv_mask=None):
@@ -285,9 +290,10 @@ class Llama:
         ``(lp, h, rng, *consts) -> (h, aux)``. ``rng`` is the schedule's
         per-(layer, microbatch) folded key (None when dropout is off);
         ``aux`` is the MoE balance loss term (0 for dense layers). The
-        ``attention_fn`` hook (flash kernel on TPU) applies inside the
-        pipeline too — but never ring attention (sequence axis can't combine
-        with the pipeline, so prepare_model never installs it here)."""
+        ``attention_fn`` hook applies inside the pipeline too: the flash
+        kernel on TPU, or — when the mesh also has a sequence axis — the
+        manual-region ring (make_local_ring_attention), which prepare_model
+        swaps in because the schedule is then manual over both axes."""
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
         h, _, aux = decoder_layer(
             self.config, h, lp, cos, sin, mask, causal=True,

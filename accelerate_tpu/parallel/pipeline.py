@@ -133,6 +133,7 @@ def make_pipeline_layers_fn(
     num_microbatches: int,
     layer_fn=None,
     virtual_stages: int = 1,
+    seq_dims=None,
 ):
     """Build ``fn(stacked_layer_params, h, *consts, dropout_rng=None) ->
     (h, aux)`` running a layer stack as a pipeline over the ``pipeline`` mesh
@@ -155,18 +156,31 @@ def make_pipeline_layers_fn(
     ``virtual_stages`` > 1 gives each device that many non-contiguous layer
     chunks (Megatron interleaved schedule) — same math, smaller bubble.
 
-    Constraints (v1): the ``sequence`` axis must be 1 (ring attention inside a
-    pipeline stage is a follow-up); layer count must divide virtual_stages ×
-    pipeline size. The microbatch count adapts downward (with a warning) when
-    it does not divide the batch.
+    ``seq_dims`` combines the pipeline with a SEQUENCE axis (ring attention
+    inside each stage): ``{"h": d, "consts": (d0, d1, ...)}`` names which
+    dimension of the activations and of each side input is the sequence
+    dimension (None = not sequence-sharded). The shard_map then goes manual
+    over BOTH axes: activations/side inputs enter as sequence-local shards,
+    and the model's layer_fn must use the manual-region ring
+    (parallel.ring_attention.make_local_ring_attention — prepare_model wires
+    this). Without ``seq_dims`` a sequence axis > 1 raises.
+
+    Other constraints: layer count must divide virtual_stages × pipeline
+    size. The microbatch count adapts downward (with a warning) when it does
+    not divide the batch.
     """
     if layer_fn is None:
         raise TypeError(
             "make_pipeline_layers_fn needs the model's per-layer function "
             "(layer_fn=model.pipeline_layer) — the schedule is model-agnostic."
         )
-    if mesh.shape.get(MESH_AXIS_SEQUENCE, 1) > 1:
-        raise NotImplementedError("pipeline + sequence axes combined is not supported yet")
+    seq_size = mesh.shape.get(MESH_AXIS_SEQUENCE, 1)
+    if seq_size > 1 and seq_dims is None:
+        raise NotImplementedError(
+            "pipeline + sequence axes need the model to declare its sequence "
+            "dimensions (pipeline_seq_dims) — this model does not"
+        )
+    manual_axes = {MESH_AXIS_PIPELINE} | ({MESH_AXIS_SEQUENCE} if seq_size > 1 else set())
     nstages = mesh.shape[MESH_AXIS_PIPELINE]
     v = virtual_stages
     if v < 1:
@@ -224,10 +238,11 @@ def make_pipeline_layers_fn(
             )
 
         def local_fn(layers, h, *rest):
-            # manual over pipeline only: h and side inputs are GLOBAL here
-            # (their data/tensor shardings are still handled by GSPMD in auto
-            # mode). ``layers`` leaves arrive as [v, 1, L/(v*P), ...]:
-            # chunk-major with the pipeline dim sharded away — squeeze it.
+            # manual over pipeline (and optionally sequence) only: h and side
+            # inputs are GLOBAL here (their data/tensor shardings are still
+            # handled by GSPMD in auto mode). ``layers`` leaves arrive as
+            # [v, 1, L/(v*P), ...]: chunk-major with the pipeline dim sharded
+            # away — squeeze it.
             layers = jax.tree.map(lambda l: l.reshape((l.shape[0],) + l.shape[2:]), layers)
             idx = jax.lax.axis_index(MESH_AXIS_PIPELINE)
             rest = list(rest)
@@ -237,7 +252,7 @@ def make_pipeline_layers_fn(
 
             def to_varying(x):
                 have = set(getattr(x.aval, "vma", ()) or ())
-                missing = tuple({MESH_AXIS_PIPELINE} - have)
+                missing = tuple(manual_axes - have)
                 return jax.lax.pcast(x, missing, to="varying") if missing else x
 
             # Become pipeline-varying while still widened (fn() promoted
@@ -245,6 +260,14 @@ def make_pipeline_layers_fn(
             # pcast is the psum that carries grads back to the replicated
             # inputs, and a bf16/fp16 psum from a manual region crashes XLA.
             h = to_varying(h).astype(h_dtype)
+            if seq_size > 1:
+                # layers are sequence-REPLICATED (only pipeline-sharded): the
+                # pcast to sequence-varying must happen on the fp32-widened
+                # values — its transpose is their grad psum over the sequence
+                # axis — and only THEN downcast to the compute dtype
+                layers = jax.tree.map(
+                    lambda l, d: to_varying(l).astype(d), layers, layer_dtypes
+                )
             consts_local: list = []
             it = iter(rest)
             for kind, dt in zip(kinds, const_dtypes):
@@ -268,6 +291,11 @@ def make_pipeline_layers_fn(
                         if has_rng
                         else None
                     )
+                    if has_rng and seq_size > 1:
+                        # sequence shards hold DIFFERENT tokens: without this
+                        # fold every shard would draw the identical dropout
+                        # mask for its local block
+                        rng = jax.random.fold_in(rng, jax.lax.axis_index(MESH_AXIS_SEQUENCE))
                     hh, a = layer_fn(lp, hh, rng, *consts_t)
                     return (hh, aux + a.astype(jnp.float32)), None
 
@@ -345,6 +373,11 @@ def make_pipeline_layers_fn(
             # over microbatches restores the full-batch scale (a sum would
             # grow the regularizer M-fold vs the non-pipeline forward)
             aux_total = jax.lax.psum(aux_acc, MESH_AXIS_PIPELINE) / M_eff
+            if seq_size > 1:
+                # sequence shards each saw their local tokens: mean them back
+                # to the full-batch scale (and resolve the varying type for
+                # the replicated out_spec)
+                aux_total = jax.lax.psum(aux_total, MESH_AXIS_SEQUENCE) / seq_size
             return outputs.reshape(h.shape), aux_total
 
         # Rearrange stacked layers [L, ...] → [v, P, L/(v*P), ...]: virtual
@@ -353,11 +386,27 @@ def make_pipeline_layers_fn(
         stacked = jax.tree.map(
             lambda l: l.reshape(v, nstages, chunk_size, *l.shape[1:]), stacked_layers
         )
-        # only the pipeline placement is manual; every other dim/axis is left
-        # to GSPMD (tensor/fsdp/expert shardings keep working inside the stage)
+        layer_dtypes = jax.tree.map(lambda l: l.dtype, stacked)
+        if seq_size > 1:
+            stacked = jax.tree.map(widen, stacked)
+        # only the pipeline (and, with seq_dims, sequence) placement is
+        # manual; every other dim/axis is left to GSPMD (tensor/fsdp/expert
+        # shardings keep working inside the stage)
+        def _seq_spec(ndim: int, dim) -> P:
+            if seq_size <= 1 or dim is None:
+                return P()
+            spec = [None] * ndim
+            spec[dim] = MESH_AXIS_SEQUENCE
+            return P(*spec)
+
         layers_specs = jax.tree.map(lambda _: P(None, MESH_AXIS_PIPELINE), stacked)
+        h_spec = _seq_spec(h.ndim, seq_dims["h"] if seq_dims else None)
+        const_dims = tuple(seq_dims["consts"]) if seq_dims else (None,) * len(consts)
+        live_specs = tuple(
+            _seq_spec(c.ndim, d) for c, d in zip(consts, const_dims) if c is not None
+        )
         args = (stacked, widen(h)) + live_consts
-        in_specs = (layers_specs, P()) + (P(),) * len(live_consts)
+        in_specs = (layers_specs, h_spec) + live_specs
         if has_rng:
             args = args + (rng_data,)
             in_specs = in_specs + (P(),)
@@ -365,8 +414,8 @@ def make_pipeline_layers_fn(
             local_fn,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=(P(), P()),
-            axis_names={MESH_AXIS_PIPELINE},
+            out_specs=(h_spec, P()),
+            axis_names=manual_axes,
         )
         return shard_fn(*args)
 

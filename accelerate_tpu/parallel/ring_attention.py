@@ -113,6 +113,9 @@ def _ring_attention_local(q, k, v, kv_valid, axis_name: str, causal: bool):
     vma = getattr(q.aval, "vma", None)
     if vma:
         o0, m0, l0 = (jax.lax.pcast(x, tuple(vma), to="varying") for x in (o0, m0, l0))
+        missing = tuple(set(vma) - set(getattr(kv_valid.aval, "vma", ()) or ()))
+        if missing:  # e.g. an all-ones mask built inside the manual region
+            kv_valid = jax.lax.pcast(kv_valid, missing, to="varying")
 
     if n > 1:
         # n-1 rotating rounds, then a final round with no wasted hop
@@ -124,6 +127,26 @@ def _ring_attention_local(q, k, v, kv_valid, axis_name: str, causal: bool):
         o, m, l = accumulate((o0, m0, l0), 0, k, v, kv_valid)
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.astype(q.dtype)
+
+
+def make_local_ring_attention(
+    axis_name: str = MESH_AXIS_SEQUENCE,
+    causal: bool = True,
+):
+    """Ring attention for code ALREADY inside a shard_map manual region over
+    ``axis_name`` (the pipeline schedule with a sequence axis): operands are
+    sequence-local shards, so no nested shard_map — the ring body runs
+    directly. Same ``attn(q, k, v, kv_mask)`` contract as
+    :func:`make_ring_attention`."""
+
+    def attn(q, k, v, kv_mask=None):
+        if kv_mask is None:
+            kv_valid = jnp.ones(q.shape[:2], bool)
+        else:
+            kv_valid = kv_mask.astype(bool)
+        return _ring_attention_local(q, k, v, kv_valid, axis_name=axis_name, causal=causal)
+
+    return attn
 
 
 def make_ring_attention(
