@@ -486,6 +486,7 @@ class Accelerator:
                     model.config, self.mesh, num_micro,
                     layer_fn=model.pipeline_layer, virtual_stages=virtual,
                     seq_dims=seq_dims,
+                    const_kinds=getattr(model, "pipeline_const_kinds", None),
                 )
                 if hasattr(model, "enc_pipeline_layer"):
                     # encoder-decoder models pipeline each stack separately
@@ -494,6 +495,7 @@ class Accelerator:
                     model.enc_pipeline_fn = make_pipeline_layers_fn(
                         model.config, self.mesh, num_micro,
                         layer_fn=model.enc_pipeline_layer, virtual_stages=virtual,
+                        const_kinds=getattr(model, "enc_pipeline_const_kinds", None),
                     )
             else:
                 model.pipeline_fn = None

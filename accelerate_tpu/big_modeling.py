@@ -527,9 +527,14 @@ class Seq2SeqStreamedModel(StreamedModel):
         model = self.model
 
         def build():
+            # use_hooks=False: the model may carry a stale mesh-bound
+            # enc_pipeline_fn from an earlier prepare_model; the streaming
+            # executor is single-device and must not trace that schedule
             if has_mask:
-                return jax.jit(lambda resident, ids, am: model.encode(resident, ids, am))
-            return jax.jit(lambda resident, ids: model.encode(resident, ids))
+                return jax.jit(
+                    lambda resident, ids, am: model.encode(resident, ids, am, use_hooks=False)
+                )
+            return jax.jit(lambda resident, ids: model.encode(resident, ids, use_hooks=False))
 
         return self._jit_cache("_encoder_fns", (s_enc, has_mask), build)
 

@@ -17,6 +17,7 @@ credentials (``--debug`` prints the exact commands instead of running them).
 
 from __future__ import annotations
 
+import re
 import shlex
 import shutil
 import subprocess
@@ -136,6 +137,10 @@ def train_command(args) -> list[str]:
         if "=" not in item:
             raise ValueError(f"--env expects KEY=VALUE, got {item!r}")
         key, _, value = item.partition("=")
+        # the key is interpolated unquoted into the remote shell line — only
+        # identifier-shaped keys are valid env names anyway
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", key):
+            raise ValueError(f"--env key must be an identifier, got {key!r}")
         parts.append(f"export {key}={shlex.quote(value)}")
     if args.setup_cmd:
         parts.append(args.setup_cmd)

@@ -205,6 +205,9 @@ class Bert:
 
     # sequence dims of the pipeline activations/side inputs (mask, kv_mask)
     pipeline_seq_dims = {"h": 1, "consts": (3, 1)}
+    # both side inputs carry the batch in dim 0 — declared so the schedule
+    # never has to infer from shape
+    pipeline_const_kinds = ("mb", "mb")
 
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 

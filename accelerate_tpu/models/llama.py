@@ -228,6 +228,11 @@ class Llama:
         h = _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None)
         if positions is None:
             positions = jnp.arange(s)[None, :]
+        elif positions.ndim == 1:
+            # normalize to [1, S]: a 1-D table would make cos/sin 2-D, and a
+            # seq length equal to the batch would then read as per-microbatch
+            # to the pipeline schedule's leading-dim inference
+            positions = positions[None, :]
         cos, sin = rotary_embedding(positions, d, cfg.rope_theta, dtype=h.dtype)
 
         mask = None
@@ -282,6 +287,9 @@ class Llama:
     # (mask, cos, sin, kv_mask) — lets the schedule combine with a sequence
     # axis (ring attention inside each stage)
     pipeline_seq_dims = {"h": 1, "consts": (3, 1, 1, 1)}
+    # cos/sin stay shape-inferred (batch-invariant [1, S, D/2] with default
+    # positions, per-row [B, S, D/2] otherwise); mask/kv_mask are batched
+    pipeline_const_kinds = ("mb", None, None, "mb")
 
     # -- pipeline hook (parallel/pipeline.make_pipeline_layers_fn) -----------
 

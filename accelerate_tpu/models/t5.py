@@ -252,8 +252,15 @@ class T5:
         input_ids: jax.Array,  # [B, S] int32
         attention_mask: Optional[jax.Array] = None,  # [B, S] 1=real
         dropout_rng: Optional[jax.Array] = None,
+        use_hooks: bool = True,
     ) -> jax.Array:
-        """Encoder hidden states [B, S, H] (final-norm applied)."""
+        """Encoder hidden states [B, S, H] (final-norm applied).
+
+        ``use_hooks=False`` bypasses the mesh-bound ``enc_pipeline_fn`` hook:
+        the streaming executor runs single-device, and a stale shard_map
+        schedule from an earlier prepare_model would be traced into its jitted
+        programs (mirrors Bert/GPT2's ``use_attention_hook=False``).
+        """
         cfg = self.config
         b, s = input_ids.shape
         h = jnp.take(params["shared_embed"], input_ids, axis=0)
@@ -267,7 +274,7 @@ class T5:
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
         use_dropout = dropout_rng is not None and cfg.dropout_rate > 0.0
-        if self.enc_pipeline_fn is not None:
+        if use_hooks and self.enc_pipeline_fn is not None:
             h, _ = self.enc_pipeline_fn(
                 params["encoder"], h, mask, bias,
                 dropout_rng=dropout_rng if use_dropout else None,
@@ -353,6 +360,12 @@ class T5:
 
     # -- pipeline hooks (parallel/pipeline.make_pipeline_layers_fn) ----------
 
+    # declared side-input kinds (pipeline.py const_kinds): decoder self_bias
+    # is batch-invariant [1, N, S, S]; self_mask varies ([1,1,S,S] causal-only
+    # vs [B,1,S,S] with a decoder mask) so it stays shape-inferred
+    pipeline_const_kinds = ("bcast", None, "mb", "mb")
+    enc_pipeline_const_kinds = ("mb", "bcast")
+
     def enc_pipeline_layer(self, lp, h, rng, mask, bias):
         """Encoder-stack ``layer_fn``: (lp, h, rng, *consts) -> (h, aux)."""
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
@@ -386,7 +399,7 @@ class T5:
         cfg = self.config
         input_ids = jnp.asarray(input_ids, jnp.int32)
         decoder_input_ids = jnp.asarray(decoder_input_ids, jnp.int32)
-        enc_out = self.encode(resident, input_ids, attention_mask)
+        enc_out = self.encode(resident, input_ids, attention_mask, use_hooks=False)
         b, s = decoder_input_ids.shape
         h = jnp.take(resident["shared_embed"], decoder_input_ids, axis=0)
         positions = jnp.arange(s)

@@ -134,6 +134,7 @@ def make_pipeline_layers_fn(
     layer_fn=None,
     virtual_stages: int = 1,
     seq_dims=None,
+    const_kinds=None,
 ):
     """Build ``fn(stacked_layer_params, h, *consts, dropout_rng=None) ->
     (h, aux)`` running a layer stack as a pipeline over the ``pipeline`` mesh
@@ -152,6 +153,12 @@ def make_pipeline_layers_fn(
       per-row position tables);
     - *broadcast* (any other shape): passed unchanged (batch-invariant rotary
       cos/sin).
+
+    ``const_kinds`` lets the model declare each side input's kind explicitly
+    (``"mb"`` / ``"bcast"`` / None = infer from shape) — the
+    ``pipeline_const_kinds`` model attribute. Without a declaration the
+    leading-dim==batch inference applies, which would silently slice a
+    batch-invariant const whose first dim coincidentally equals the batch.
 
     ``virtual_stages`` > 1 gives each device that many non-contiguous layer
     chunks (Megatron interleaved schedule) — same math, smaller bubble.
@@ -185,6 +192,10 @@ def make_pipeline_layers_fn(
     v = virtual_stages
     if v < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    if const_kinds is not None:
+        bad = [k for k in const_kinds if k not in (None, "mb", "bcast")]
+        if bad:
+            raise ValueError(f'const_kinds entries must be None, "mb" or "bcast"; got {bad}')
     if cfg.num_layers % (v * nstages) != 0:
         raise ValueError(
             f"num_layers={cfg.num_layers} must divide virtual_stages*pipeline "
@@ -195,12 +206,21 @@ def make_pipeline_layers_fn(
 
     def fn(stacked_layers, h, *consts, dropout_rng=None):
         b = h.shape[0]
-        # classify each side input: None / per-microbatch / broadcast. The
-        # leading-dim==batch rule is documented above; side inputs whose
-        # first dim coincidentally equals the batch are treated as batched.
+        # classify each side input: None / per-microbatch / broadcast.
+        # Declared kinds win; the leading-dim==batch inference covers the
+        # rest (a batch-invariant const whose first dim coincidentally equals
+        # the batch must be declared "bcast" to avoid being sliced).
+        declared = const_kinds if const_kinds is not None else (None,) * len(consts)
+        if len(declared) != len(consts):
+            raise ValueError(
+                f"const_kinds declares {len(declared)} side inputs but the "
+                f"pipeline call passed {len(consts)}"
+            )
         kinds = tuple(
-            "none" if c is None else ("mb" if (c.ndim >= 1 and c.shape[0] == b) else "bcast")
-            for c in consts
+            "none"
+            if c is None
+            else (k or ("mb" if (c.ndim >= 1 and c.shape[0] == b) else "bcast"))
+            for c, k in zip(consts, declared)
         )
         # Replicated float operands cross the shard_map boundary in fp32: the
         # transpose of the implicit pipeline-axis broadcast of a replicated

@@ -65,6 +65,15 @@ def test_env_validation():
         train_command(_args(env=["BROKEN"]))
 
 
+def test_env_key_must_be_identifier():
+    # the key lands unquoted in the remote shell line — metacharacters would
+    # inject into the ssh command
+    with pytest.raises(ValueError, match="identifier"):
+        train_command(_args(env=["A B=x"]))
+    with pytest.raises(ValueError, match="identifier"):
+        train_command(_args(env=["$(reboot)=x"]))
+
+
 def test_cli_debug_prints_plan():
     result = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.cli", "cloud-launch",

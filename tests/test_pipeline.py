@@ -232,3 +232,41 @@ def test_interleaved_schedule_reduces_idle():
     *_, idle_v1 = build_interleaved_schedule(4, 1, 8)
     *_, idle_v2 = build_interleaved_schedule(4, 2, 8)
     assert idle_v2 < idle_v1
+
+
+def test_declared_bcast_const_with_batchlike_leading_dim():
+    """ADVICE r4: a batch-invariant const whose leading dim coincidentally
+    equals the batch must not be sliced per microbatch when the model
+    declares it "bcast"."""
+    from accelerate_tpu.parallel.pipeline import make_pipeline_layers_fn
+    from accelerate_tpu.models.attention import rotary_embedding
+
+    state = PartialState(parallelism=ParallelismConfig(pipeline=2))
+    model, params = _fresh_4layer_model(seed=11)
+    cfg = model.config
+    b = 4
+    ids = jnp.asarray(np.random.default_rng(11).integers(0, 1024, (b, b)), jnp.int32)
+    h = jnp.take(params["embed_tokens"], ids, axis=0)
+    # seq == batch: cos/sin are [S=b, D/2] — the shape heuristic would slice
+    # them per microbatch and feed wrong positions
+    cos, sin = rotary_embedding(jnp.arange(b), cfg.dim_per_head, cfg.rope_theta)
+    assert cos.shape[0] == b
+
+    expected_h = h
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda x: x[i], params["layers"])
+        expected_h, _ = model.pipeline_layer(lp, expected_h, None, None, cos, sin, None)
+
+    fn = make_pipeline_layers_fn(
+        cfg, state.mesh, num_microbatches=2, layer_fn=model.pipeline_layer,
+        const_kinds=("mb", "bcast", "bcast", "mb"),
+    )
+    got, _ = jax.jit(fn)(params["layers"], h, None, cos, sin, None)
+    np.testing.assert_allclose(np.asarray(expected_h), np.asarray(got), atol=1e-5)
+
+    # declared count must match the call
+    with pytest.raises(ValueError, match="side inputs"):
+        jax.jit(make_pipeline_layers_fn(
+            cfg, state.mesh, num_microbatches=2, layer_fn=model.pipeline_layer,
+            const_kinds=("mb",),
+        ))(params["layers"], h, None, cos, sin, None)

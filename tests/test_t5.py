@@ -224,3 +224,26 @@ def test_t5_pipeline_trains():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_t5_streamed_ignores_stale_pipeline_hook():
+    """A mesh-bound enc_pipeline_fn left on the model must not be traced into
+    the single-device streaming executor (ADVICE r4: mirror Bert's
+    use_attention_hook=False pattern)."""
+    model, params = _model_and_params(seed=10)
+    params = jax.device_get(params)
+    batch = _batch(seed=10, b=2)
+    dec = model.shift_right(batch["labels"])
+    expected = np.asarray(model.apply(params, batch["input_ids"], dec))
+
+    Accelerator(parallelism=ParallelismConfig(pipeline=2)).prepare_model(model, params=params)
+    assert model.enc_pipeline_fn is not None  # stale hook installed
+    from accelerate_tpu.big_modeling import make_layered_device_map
+
+    lm = dispatch_model(
+        model, params, device_map=make_layered_device_map(model, "cpu"), dtype=jnp.float32
+    )
+    got = np.asarray(lm(batch["input_ids"], dec))
+    np.testing.assert_allclose(expected, got, atol=2e-3)
+    out = lm.generate(batch["input_ids"], max_new_tokens=3)
+    assert out.shape == (2, 4)
