@@ -412,16 +412,17 @@ class Accelerator:
 
                 model.attention_fn = make_ring_attention(self.mesh, causal=causal)
             elif (
-                causal
-                and self.compilation_config.flash_attention_min_seq
+                self.compilation_config.flash_attention_min_seq
                 and jax.default_backend() == "tpu"
             ):
                 # long sequences stream through the Pallas flash kernel; short
-                # ones keep the XLA einsum path (per-shape dispatch)
+                # ones keep the XLA einsum path (per-shape dispatch). v2 covers
+                # non-causal (Bert/T5-encoder), padding masks, and additive
+                # bias, so every attention_fn model gets the hook.
                 from .ops.flash_attention import make_auto_attention
 
                 model.attention_fn = make_auto_attention(
-                    self.compilation_config.flash_attention_min_seq
+                    self.compilation_config.flash_attention_min_seq, causal=causal
                 )
             else:
                 model.attention_fn = None

@@ -8,7 +8,11 @@ table, loadable from any Hub checkpoint). Three input forms:
 - a checkpoint path (file or directory): shapes/dtypes are read from the
   safetensors headers (8-byte length + JSON — zero tensor bytes touched) or
   the ``.npz`` member headers, covering anything ``save_model_weights``
-  produced, sharded or not.
+  produced, sharded or not;
+- a HF ``config.json`` (file, or a directory holding one but no weights):
+  the config maps to a zoo TransformerConfig and the count is exact with NO
+  weights present — the offline analogue of the reference's
+  "estimate any Hub model from its config" (estimate.py:215-299).
 """
 
 from __future__ import annotations
@@ -114,8 +118,31 @@ def count_params(model_name: str) -> int:
     return param_count(get_config(model_name))
 
 
+def _config_json_path(path: str) -> str | None:
+    """The config.json to estimate from, when the path is config-only."""
+    if os.path.isfile(path):
+        return path if path.endswith(".json") else None
+    candidate = os.path.join(path, "config.json")
+    has_weights = any(
+        name.endswith((".safetensors", ".npz")) for name in os.listdir(path)
+    )
+    # weights present → the header route is exact for the actual checkpoint
+    return candidate if os.path.exists(candidate) and not has_weights else None
+
+
 def run(args) -> int:
-    if os.path.exists(args.model_name):
+    config_json = _config_json_path(args.model_name) if os.path.exists(args.model_name) else None
+    if config_json is not None:
+        from ..models.config import config_from_hf_json, param_count
+
+        config = config_from_hf_json(config_json)
+        n = param_count(config)
+        print(
+            f"Config: {config_json} — arch {config.arch}, "
+            f"{config.num_layers} layers, hidden {config.hidden_size}, "
+            f"{n:,} parameters ({n / 1e9:.2f}B)"
+        )
+    elif os.path.exists(args.model_name):
         entries = checkpoint_entries(args.model_name)
         import numpy as np
 

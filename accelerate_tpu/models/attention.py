@@ -55,6 +55,7 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,  # [B, 1, S, T] or broadcastable, True = attend
     causal: bool = False,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,  # [1|B, N, S, T] additive (T5 rel bias)
 ) -> jax.Array:
     """Grouped-query attention; softmax in fp32 for stability."""
     b, s, n, d = q.shape
@@ -68,6 +69,8 @@ def dot_product_attention(
     else:
         logits = jnp.einsum("bsnd,btnd->bnst", q * scale, k)
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         causal_mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
         logits = jnp.where(causal_mask[None, None], logits, -1e30)

@@ -104,6 +104,10 @@ class T5:
         self.dot_fn = None
         self.pipeline_fn = None  # decoder stack (params["layers"])
         self.enc_pipeline_fn = None  # encoder stack (params["encoder"])
+        # attention hook: engaged only when it declares supports_bias (the
+        # flash auto-attention does; ring hooks don't carry T5's additive
+        # relative-position bias and are skipped — einsum stays exact)
+        self.attention_fn = None
 
     # -- parameters --------------------------------------------------------
 
@@ -176,7 +180,20 @@ class T5:
 
     # -- layer bodies -------------------------------------------------------
 
-    def _enc_layer(self, h, lp, bias, mask, rngs=(None, None)):
+    def _attn(self, q, k, v, bias, mask, kv_mask, causal: bool, use_hook: bool = True):
+        """Self/cross attention through the hook when it can carry the bias
+        (flash kernel path), else the exact einsum. ``mask`` is the 4-D
+        broadcast mask for the einsum; ``kv_mask`` the raw [B, S] validity
+        the kernel wants (None = nothing masked beyond causality).
+        ``use_hook=False`` forces the einsum — callers that only hold the
+        4-D mask (streamed decoder layers) must not drop padding by handing
+        the hook a None kv_mask."""
+        fn = self.attention_fn
+        if use_hook and fn is not None and getattr(fn, "supports_bias", False):
+            return fn(q, k, v, kv_mask, bias=bias, scale=1.0, causal=causal)
+        return t5_attention(q, k, v, bias, mask)
+
+    def _enc_layer(self, h, lp, bias, mask, rngs=(None, None), kv_mask=None):
         cfg = self.config
         dot = resolve_dot(self.dot_fn)
         b, s = h.shape[:2]
@@ -185,7 +202,7 @@ class T5:
         q = dot(x, lp["wq"]).reshape(b, s, nh, d)
         k = dot(x, lp["wk"]).reshape(b, s, nh, d)
         v = dot(x, lp["wv"]).reshape(b, s, nh, d)
-        attn = t5_attention(q, k, v, bias, mask)
+        attn = self._attn(q, k, v, bias, mask, kv_mask, causal=False)
         attn_out = dot(attn.reshape(b, s, nh * d), lp["wo"])
         if rngs[0] is not None:
             attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
@@ -198,7 +215,8 @@ class T5:
 
     def _dec_layer(
         self, h, lp, self_bias, self_mask, enc_out, enc_mask,
-        rngs=(None, None, None), cache=None, length=None,
+        rngs=(None, None, None), cache=None, length=None, kv_masks=(None, None),
+        use_hook: bool = True,
     ):
         """One decoder layer: self-attn (+rel bias) → cross-attn → FF.
 
@@ -221,7 +239,7 @@ class T5:
             attn = t5_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), self_bias, self_mask)
             new_cache = {"k": k_cache, "v": v_cache}
         else:
-            attn = t5_attention(q, k, v, self_bias, self_mask)
+            attn = self._attn(q, k, v, self_bias, self_mask, kv_masks[0], causal=True, use_hook=use_hook)
         attn_out = dot(attn.reshape(b, s, nh * d), lp["self_wo"])
         if rngs[0] is not None:
             attn_out = dropout(attn_out, cfg.dropout_rate, rngs[0])
@@ -231,7 +249,7 @@ class T5:
         q = dot(x, lp["cross_wq"]).reshape(b, s, nh, d)
         ek = dot(enc_out, lp["cross_wk"]).reshape(b, enc_out.shape[1], nh, d)
         ev = dot(enc_out, lp["cross_wv"]).reshape(b, enc_out.shape[1], nh, d)
-        cross = t5_attention(q, ek, ev, None, enc_mask)
+        cross = self._attn(q, ek, ev, None, enc_mask, kv_masks[1], causal=False, use_hook=use_hook)
         cross_out = dot(cross.reshape(b, s, nh * d), lp["cross_wo"])
         if rngs[1] is not None:
             cross_out = dropout(cross_out, cfg.dropout_rate, rngs[1])
@@ -286,7 +304,7 @@ class T5:
         def layer(h, xs):
             lp = xs[0] if use_dropout else xs
             rngs = tuple(xs[1]) if use_dropout else (None, None)
-            h = self._enc_layer(h, lp, bias, mask, rngs)
+            h = self._enc_layer(h, lp, bias, mask, rngs, kv_mask=attention_mask)
             return _constrain(h, BATCH_AXES, MESH_AXIS_SEQUENCE, None), None
 
         xs = (params["encoder"], layer_rngs) if use_dropout else params["encoder"]
@@ -345,7 +363,10 @@ class T5:
             def layer(h, xs):
                 lp = xs[0] if use_dropout else xs
                 rngs = tuple(xs[1]) if use_dropout else (None, None, None)
-                h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, rngs)
+                h = self._dec_layer(
+                    h, lp, self_bias, self_mask, enc_out, enc_mask, rngs,
+                    kv_masks=(decoder_attention_mask, attention_mask),
+                )
                 return _constrain(h, BATCH_AXES, None, None), None
 
             xs = (params["layers"], layer_rngs) if use_dropout else params["layers"]
@@ -367,16 +388,23 @@ class T5:
     enc_pipeline_const_kinds = ("mb", "bcast")
 
     def enc_pipeline_layer(self, lp, h, rng, mask, bias):
-        """Encoder-stack ``layer_fn``: (lp, h, rng, *consts) -> (h, aux)."""
+        """Encoder-stack ``layer_fn``: (lp, h, rng, *consts) -> (h, aux).
+        The raw key validity is recovered from the [B,1,1,S] const so the
+        flash hook stays engaged inside pipeline stages."""
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
-        h = self._enc_layer(h, lp, bias, mask, rngs)
+        kv_mask = None if mask is None else mask[:, 0, 0, :]
+        h = self._enc_layer(h, lp, bias, mask, rngs, kv_mask=kv_mask)
         return h, jnp.zeros((), jnp.float32)
 
     def pipeline_layer(self, lp, h, rng, self_bias, self_mask, enc_out, enc_mask):
         """Decoder-stack ``layer_fn``: cross-attention reads the encoder
-        output carried as a per-microbatch side input."""
+        output carried as a per-microbatch side input. The consts hold only
+        4-D masks (causality folded in), so the attention hook is bypassed —
+        the einsum path is exact for the decoder's short sequences."""
         rngs = (None, None, None) if rng is None else tuple(jax.random.split(rng, 3))
-        h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, rngs)
+        h = self._dec_layer(
+            h, lp, self_bias, self_mask, enc_out, enc_mask, rngs, use_hook=False
+        )
         return h, jnp.zeros((), jnp.float32)
 
     def _lm_logits(self, params, h):
@@ -417,7 +445,9 @@ class T5:
 
     def stream_layer(self, carry, lp):
         h, self_bias, self_mask, enc_out, enc_mask = carry
-        h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask)
+        # use_hook=False: the carry holds only 4-D masks, and a stale or
+        # kv_mask-less hook would drop padding (see _attn)
+        h = self._dec_layer(h, lp, self_bias, self_mask, enc_out, enc_mask, use_hook=False)
         return (h, self_bias, self_mask, enc_out, enc_mask)
 
     def stream_suffix(self, resident, carry):

@@ -46,12 +46,149 @@ def test_gradients_match_reference(kv):
         )
 
 
-def test_mask_falls_back_to_reference():
-    q, k, v = _qkv(b=1, s=128, n=2, kv=2, d=64)
-    mask = jnp.asarray([[1] * 100 + [0] * 28], jnp.int32)
-    got = flash_attention(q, k, v, kv_mask=mask)
+def test_masked_runs_in_kernel():
+    """v2: a [B, S] padding mask runs IN the kernel (no einsum fallback)."""
+    q, k, v = _qkv(b=2, s=256, n=2, kv=2, d=64)
+    mask = jnp.asarray([[1] * 200 + [0] * 56, [1] * 256], jnp.int32)
+    got = flash_attention(q, k, v, kv_mask=mask, block_q=128, block_k=128)
     want = dot_product_attention(q, k, v, mask=mask[:, None, None, :].astype(bool), causal=True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_masked_gradients_match_reference():
+    q, k, v = _qkv(b=2, s=256, n=2, kv=2, d=64, seed=6)
+    mask = jnp.asarray([[1] * 130 + [0] * 126, [1] * 256], jnp.int32)
+    mask4 = mask[:, None, None, :].astype(bool)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, kv_mask=mask, block_q=128, block_k=128)
+        return ((out * mask[..., None, None]) ** 2).sum()  # loss ignores padding
+
+    def loss_ref(q, k, v):
+        out = dot_product_attention(q, k, v, mask=mask4, causal=True)
+        return ((out * mask[..., None, None]) ** 2).sum()
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_noncausal_matches_reference(masked):
+    """v2 non-causal mode (Bert/T5-encoder): values and gradients."""
+    q, k, v = _qkv(b=2, s=256, n=2, kv=2, d=64, seed=7)
+    mask = jnp.asarray([[1] * 180 + [0] * 76, [1] * 256], jnp.int32) if masked else None
+    mask4 = None if mask is None else mask[:, None, None, :].astype(bool)
+
+    got = flash_attention(q, k, v, kv_mask=mask, causal=False, block_q=128, block_k=128)
+    want = dot_product_attention(q, k, v, mask=mask4, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    w_ = None if mask is None else mask[..., None, None]
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, kv_mask=mask, causal=False, block_q=128, block_k=128)
+        return ((out if w_ is None else out * w_) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out = dot_product_attention(q, k, v, mask=mask4, causal=False)
+        return ((out if w_ is None else out * w_) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+@pytest.mark.parametrize("batched_bias", [False, True])
+def test_bias_matches_reference(batched_bias):
+    """v2 additive bias (T5 relative positions): values and ALL gradients,
+    including the bias gradient (batch-reduced for broadcast [1, ...] bias)."""
+    b = 2
+    q, k, v = _qkv(b=b, s=256, n=2, kv=2, d=64, seed=8)
+    rng = np.random.default_rng(8)
+    bias = jnp.asarray(rng.normal(size=(b if batched_bias else 1, 2, 256, 256)).astype(np.float32))
+    mask = jnp.asarray([[1] * 140 + [0] * 116, [1] * 256], jnp.int32)
+    mask4 = mask[:, None, None, :].astype(bool)
+
+    got = flash_attention(
+        q, k, v, kv_mask=mask, causal=False, bias=bias, scale=1.0, block_q=128, block_k=128
+    )
+    want = dot_product_attention(q, k, v, mask=mask4, causal=False, bias=bias, scale=1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q, k, v, bias):
+        out = flash_attention(
+            q, k, v, kv_mask=mask, causal=False, bias=bias, scale=1.0, block_q=128, block_k=128
+        )
+        return ((out * mask[..., None, None]) ** 2).sum()
+
+    def loss_ref(q, k, v, bias):
+        out = dot_product_attention(q, k, v, mask=mask4, causal=False, bias=bias, scale=1.0)
+        return ((out * mask[..., None, None]) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for g, w, name in zip(g1, g2, ["q", "k", "v", "bias"]):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_causal_bias_matches_reference():
+    """Causal + bias (T5 decoder self-attention)."""
+    q, k, v = _qkv(b=1, s=256, n=2, kv=2, d=64, seed=9)
+    rng = np.random.default_rng(9)
+    bias = jnp.asarray(rng.normal(size=(1, 2, 256, 256)).astype(np.float32))
+    got = flash_attention(q, k, v, causal=True, bias=bias, scale=1.0, block_q=128, block_k=128)
+    want = dot_product_attention(q, k, v, causal=True, bias=bias, scale=1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def lf(bias):
+        return (flash_attention(q, k, v, causal=True, bias=bias, scale=1.0, block_q=128, block_k=128) ** 2).sum()
+
+    def lr(bias):
+        return (dot_product_attention(q, k, v, causal=True, bias=bias, scale=1.0) ** 2).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(lf)(bias)), np.asarray(jax.grad(lr)(bias)), rtol=5e-4, atol=5e-4
+    )
+
+
+def test_cross_attention_distinct_lengths():
+    """Non-causal q-len != kv-len (T5 cross-attention) runs the kernel."""
+    rng = np.random.default_rng(10)
+    q = jnp.asarray(rng.normal(size=(2, 128, 2, 64)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(2, 256, 2, 64)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(2, 256, 2, 64)).astype(np.float32))
+    mask = jnp.asarray([[1] * 256, [1] * 150 + [0] * 106], jnp.int32)
+    got = flash_attention(q, k, v, kv_mask=mask, causal=False, block_q=128, block_k=128)
+    want = dot_product_attention(q, k, v, mask=mask[:, None, None, :].astype(bool), causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_fully_masked_rows_are_finite():
+    """A fully-padded batch row must give 0 output and 0 gradients, not NaN
+    (the einsum path gives a uniform softmax there; either is fine — the
+    rows are padding — but NaN would poison the whole residual stream)."""
+    q, k, v = _qkv(b=2, s=256, n=2, kv=2, d=64, seed=11)
+    mask = jnp.asarray([[0] * 256, [1] * 256], jnp.int32)
+    out = flash_attention(q, k, v, kv_mask=mask, causal=False, block_q=128, block_k=128)
+    out = np.asarray(out)
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[0], 0.0)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, kv_mask=mask, causal=False, block_q=128, block_k=128)
+        return ((out * mask[..., None, None]) ** 2).sum()
+
+    for g in jax.grad(loss, argnums=(0, 1, 2))(q, k, v):
+        assert np.isfinite(np.asarray(g)).all()
 
 
 def test_odd_seq_falls_back():
@@ -115,3 +252,105 @@ def test_block_adaptation_keeps_kernel_for_128_multiples():
     got = flash_attention(q, k, v)
     want = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# zoo wiring: bert / t5 route through the kernel (VERDICT r4 #4 dispatch
+# counter — monkeypatching the custom_vjp primal proves the KERNEL ran, not
+# the einsum fallback inside flash_attention)
+# ---------------------------------------------------------------------------
+
+
+def _count_kernel_calls(monkeypatch):
+    import accelerate_tpu.ops.flash_attention as fa
+
+    calls = {"n": 0}
+    orig = fa._flash_attention_bnsd
+
+    def counted(*args, **kwargs):
+        calls["n"] += 1
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa, "_flash_attention_bnsd", counted)
+    return calls
+
+
+def test_bert_masked_batch_hits_kernel(monkeypatch):
+    """Non-causal + padding mask: bert's attention_fn engages the kernel and
+    matches the hook-less model."""
+    from accelerate_tpu.models import Bert
+    from accelerate_tpu.ops.flash_attention import make_auto_attention
+
+    calls = _count_kernel_calls(monkeypatch)
+    model = Bert("bert-tiny")
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 1024, (2, 128)), jnp.int32)
+    am = jnp.asarray([[1] * 128, [1] * 70 + [0] * 58], jnp.int32)
+
+    want = np.asarray(model.apply(params, ids, attention_mask=am))
+    model.attention_fn = make_auto_attention(min_seq=128, causal=False)
+    got = np.asarray(model.apply(params, ids, attention_mask=am))
+    assert calls["n"] > 0, "bert attention never reached the flash kernel"
+    np.testing.assert_allclose(want, got, rtol=2e-4, atol=2e-4)
+
+
+def test_t5_hits_kernel_with_bias(monkeypatch):
+    """T5 encoder (bias, non-causal, mask) + decoder self-attn (bias, causal)
+    + cross-attn (distinct lengths) all route through the kernel and match
+    the einsum model; gradients stay finite and close."""
+    from accelerate_tpu.models import T5
+    from accelerate_tpu.ops.flash_attention import make_auto_attention
+
+    calls = _count_kernel_calls(monkeypatch)
+    model = T5("t5-tiny")
+    params = model.init(jax.random.key(1))
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 1024, (2, 256)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 1024, (2, 128)), jnp.int32)
+    am = jnp.asarray([[1] * 256, [1] * 150 + [0] * 106], jnp.int32)
+    dm = jnp.asarray([[1] * 128, [1] * 90 + [0] * 38], jnp.int32)
+    dec = model.shift_right(labels)
+
+    want = np.asarray(model.apply(params, ids, dec, am, dm))
+    model.attention_fn = make_auto_attention(min_seq=128)
+    got = np.asarray(model.apply(params, ids, dec, am, dm))
+    # one trace per attention SITE (the layer stack is a lax.scan, so the
+    # body traces once): encoder self + decoder self + cross = 3
+    assert calls["n"] >= 3, f"expected every t5 attention site in the kernel, got {calls['n']}"
+    real = np.asarray(dm, bool)
+    np.testing.assert_allclose(want[real], got[real], rtol=5e-4, atol=5e-4)
+
+    def loss(params):
+        logits = model.apply(params, ids, dec, am, dm).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return (nll * dm).sum() / dm.sum()
+
+    grads = jax.grad(loss)(params)
+    for leaf in jax.tree.leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_prepare_model_wires_noncausal_hook(monkeypatch):
+    """prepare_model installs the flash hook for bidirectional models too —
+    only on TPU backends, so assert via the factory call."""
+    import accelerate_tpu.accelerator as acc_mod
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import Bert
+
+    wired = {}
+    import accelerate_tpu.ops.flash_attention as fa
+
+    orig_factory = fa.make_auto_attention
+
+    def spy(min_seq, causal=True):
+        wired["args"] = (min_seq, causal)
+        return orig_factory(min_seq, causal=causal)
+
+    monkeypatch.setattr(fa, "make_auto_attention", spy)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    model = Bert("bert-tiny")
+    Accelerator().prepare_model(model)
+    assert wired["args"][1] is False  # bert: non-causal kernel
+    assert model.attention_fn is not None
