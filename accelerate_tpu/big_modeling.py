@@ -80,6 +80,22 @@ def _device_put_packed(buf):
     return jax.device_put(jnp.asarray(buf))
 
 
+def _bytes_view(buf) -> list[np.ndarray]:
+    """Raw little-endian byte views of a packed host buffer (no copy for
+    plain buffers; quantized (q, f) pairs yield two views)."""
+    parts = buf if isinstance(buf, tuple) else (buf,)
+    return [np.asarray(part).view(np.uint8).ravel() for part in parts]
+
+
+def _bitcast_u8(u8: jax.Array, dtype) -> jax.Array:
+    """Reinterpret a device uint8 buffer as ``dtype`` (on-device, free at
+    HBM bandwidth — the XLA analogue of np.view)."""
+    itemsize = _np_dtype(dtype).itemsize
+    if itemsize == 1:
+        return jax.lax.bitcast_convert_type(u8, dtype)
+    return jax.lax.bitcast_convert_type(u8.reshape(-1, itemsize), dtype)
+
+
 def _unflatten(flat: dict[str, Any]) -> dict:
     out: dict = {}
     for key, value in flat.items():
@@ -140,6 +156,16 @@ class LayerPacker:
             out[key] = buf[offset : offset + size].reshape(self.shapes[key])
         return _unflatten(out)
 
+    @property
+    def layer_nbytes(self) -> int:
+        """Packed byte footprint of one layer (group-buffer layout unit)."""
+        return int(self.total * _np_dtype(self.dtype).itemsize)
+
+    def from_bytes(self, u8: jax.Array) -> dict:
+        """Unpack one layer from its raw byte slice of a group buffer
+        (on-device bitcast; used inside jit)."""
+        return self.unpack(_bitcast_u8(u8, self.dtype))
+
 
 class _LayerStreamer:
     """Shared streaming machinery: packed layer buffers on device/host/disk,
@@ -175,20 +201,34 @@ class _LayerStreamer:
 
     def _layer_bytes(self) -> int:
         """Packed on-device footprint of one layer buffer."""
-        packer = self.packer
-        if isinstance(packer, QuantizedLayerPacker):
-            return int(packer.q_total + packer.f_total * 4)
-        return int(packer.total * _np_dtype(packer.dtype).itemsize)
+        return self.packer.layer_nbytes
 
     def _put(self, buf):
         return _device_put_packed(buf)
 
     def _put_group(self, idx: list[int]):
-        """Issue async transfers for every offloaded layer in the group."""
-        return [
-            self.layer_buffers[i] if self.layer_on_device[i] else self._put(self.layer_buffers[i])
-            for i in idx
-        ]
+        """Stage one group: the offloaded layers' packed bytes concatenate
+        into ONE contiguous uint8 host buffer and ride ONE async H2D DMA —
+        remote/tunneled transports pay a fixed latency per transfer, so G
+        per-layer puts (2G for quantized (q, f) pairs) cost G× the latency
+        of one group put for the same bytes. Splitting back into per-layer
+        params happens on device inside the jitted group program
+        (packer.from_bytes — static slices + bitcast, HBM-bandwidth cheap).
+
+        Returns ``(u8, resident, pattern)``: the group DMA (None when every
+        layer is already on device), the device-resident packed buffers, and
+        the static resident/streamed pattern that keys the group program.
+        """
+        pattern = tuple(bool(self.layer_on_device[i]) for i in idx)
+        resident = tuple(self.layer_buffers[i] for i in idx if self.layer_on_device[i])
+        host_parts: list[np.ndarray] = []
+        for i in idx:
+            if not self.layer_on_device[i]:
+                host_parts.extend(_bytes_view(self.layer_buffers[i]))
+        if not host_parts:
+            return None, resident, pattern
+        host = host_parts[0] if len(host_parts) == 1 else np.concatenate(host_parts)
+        return jax.device_put(jnp.asarray(host)), resident, pattern
 
     def _group_indices(self) -> list[list[int]]:
         L = len(self.layer_buffers)
@@ -196,16 +236,17 @@ class _LayerStreamer:
         return [list(range(i, min(i + g, L))) for i in range(0, L, g)]
 
     def _iter_device_layer_groups(self):
-        """Yield lists of on-device packed buffers, double-buffering groups:
-        group i+1's H2D transfers are in flight while group i executes."""
+        """Yield staged groups, double-buffering: group i's compute is
+        dispatched (async) by the caller right after the yield, so group
+        i+1's host-side concatenation AND its H2D DMA overlap group i's
+        on-device execution."""
         groups = self._group_indices()
-        next_bufs = None
-        for gi, idx in enumerate(groups):
-            current = next_bufs if next_bufs is not None else self._put_group(idx)
-            next_bufs = None
-            if gi + 1 < len(groups):
-                next_bufs = self._put_group(groups[gi + 1])  # async: overlaps compute
-            yield current
+        if not groups:
+            return
+        staged = self._put_group(groups[0])
+        for gi in range(len(groups)):
+            yield staged
+            staged = self._put_group(groups[gi + 1]) if gi + 1 < len(groups) else None
 
 
 class QuantizedLayerPacker:
@@ -268,6 +309,19 @@ class QuantizedLayerPacker:
             offset, size = self.f_offsets[key]
             fbuf[offset : offset + size] = np.asarray(flat[key], np.float32).ravel()
         return (qbuf, fbuf)
+
+    @property
+    def layer_nbytes(self) -> int:
+        """Packed byte footprint (int8 data + fp32 sidecar) of one layer."""
+        return int(self.q_total + self.f_total * 4)
+
+    def from_bytes(self, u8: jax.Array) -> dict:
+        """Unpack one quantized layer from its byte slice of a group buffer:
+        the int8 data and the fp32 sidecar ride ONE buffer (one DMA), split
+        and bitcast on device inside the jitted program."""
+        q = _bitcast_u8(u8[: self.q_total], jnp.int8)
+        f = _bitcast_u8(u8[self.q_total :], jnp.float32)
+        return self.unpack((q, f))
 
     def unpack(self, bufs) -> dict:
         from .utils.quantization import dequantize_weight
@@ -384,26 +438,42 @@ class StreamedModel(_LayerStreamer):
 
         return dot_keyed_jit(self, store_name, key, build, dot_holder=self.model)
 
-    def _get_group_fn(self, n: int):
-        unpack, stream_layer = self.packer.unpack, self.model.stream_layer
+    def _iter_group_layers(self, pattern, u8, resident_bufs):
+        """Per-layer param trees of one staged group, inside jit: resident
+        buffers unpack directly; streamed layers slice the group's byte
+        buffer at static offsets and bitcast (packer.from_bytes)."""
+        packer = self.packer
+        nbytes = packer.layer_nbytes
+        ri = off = 0
+        for is_resident in pattern:
+            if is_resident:
+                yield packer.unpack(resident_bufs[ri])
+                ri += 1
+            else:
+                yield packer.from_bytes(u8[off : off + nbytes])
+                off += nbytes
+
+    def _get_group_fn(self, pattern: tuple):
+        stream_layer = self.model.stream_layer
+        iter_layers = self._iter_group_layers
 
         def build():
             @jax.jit
-            def group_fn(carry, bufs):
-                for buf in bufs:
-                    carry = stream_layer(carry, unpack(buf))
+            def group_fn(carry, u8, resident_bufs):
+                for lp in iter_layers(pattern, u8, resident_bufs):
+                    carry = stream_layer(carry, lp)
                 return carry
 
             return group_fn
 
-        return self._jit_cache("_group_fns", n, build)
+        return self._jit_cache("_group_fns", pattern, build)
 
     def __call__(self, *args, **kwargs):
         self._before_execute()
         resident = self.resident_tree()
         carry = self.model.stream_prefix(resident, *args, **kwargs)
-        for bufs in self._iter_device_layer_groups():
-            carry = self._get_group_fn(len(bufs))(carry, tuple(bufs))
+        for u8, res, pattern in self._iter_device_layer_groups():
+            carry = self._get_group_fn(pattern)(carry, u8, res)
         return self.model.stream_suffix(resident, carry)
 
     # -- streamed KV-cache decode (models exposing the decode protocol:
@@ -422,21 +492,22 @@ class StreamedModel(_LayerStreamer):
 
         return self._jit_cache("_decode_preludes", max_len, build)
 
-    def _get_decode_group_fn(self, n: int):
-        model, unpack = self.model, self.packer.unpack
+    def _get_decode_group_fn(self, pattern: tuple):
+        model = self.model
+        iter_layers = self._iter_group_layers
 
         def build():
             @jax.jit
-            def fn(carry, bufs, caches, length):
+            def fn(carry, u8, resident_bufs, caches, length):
                 new_caches = []
-                for buf, c in zip(bufs, caches):
-                    carry, nc = model.stream_layer_cached(carry, unpack(buf), c, length)
+                for lp, c in zip(iter_layers(pattern, u8, resident_bufs), caches):
+                    carry, nc = model.stream_layer_cached(carry, lp, c, length)
                     new_caches.append(nc)
                 return carry, tuple(new_caches)
 
             return fn
 
-        return self._jit_cache("_decode_group_fns", n, build)
+        return self._jit_cache("_decode_group_fns", pattern, build)
 
     def _get_decode_tail(self, sampled: bool):
         model = self.model
@@ -491,10 +562,10 @@ class StreamedModel(_LayerStreamer):
         length = jnp.zeros((), jnp.int32)
         for _ in range(max_new_tokens):
             carry, new_length = prelude(resident, current, length)
-            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
+            for idx, (u8, res, pattern) in zip(groups, self._iter_device_layer_groups()):
                 gcaches = tuple(caches[i] for i in idx)
-                carry, new_caches = self._get_decode_group_fn(len(idx))(
-                    carry, tuple(bufs), gcaches, length
+                carry, new_caches = self._get_decode_group_fn(pattern)(
+                    carry, u8, res, gcaches, length
                 )
                 for i, nc in zip(idx, new_caches):
                     caches[i] = nc
@@ -595,10 +666,10 @@ class Seq2SeqStreamedModel(StreamedModel):
         length = jnp.zeros((), jnp.int32)
         for _ in range(max_new_tokens):
             carry, new_length = prelude(resident, current, length, enc_out, enc_mask)
-            for idx, bufs in zip(groups, self._iter_device_layer_groups()):
+            for idx, (u8, res, pattern) in zip(groups, self._iter_device_layer_groups()):
                 gcaches = tuple(caches[i] for i in idx)
-                carry, new_caches = self._get_decode_group_fn(len(idx))(
-                    carry, tuple(bufs), gcaches, length
+                carry, new_caches = self._get_decode_group_fn(pattern)(
+                    carry, u8, res, gcaches, length
                 )
                 for i, nc in zip(idx, new_caches):
                     caches[i] = nc
