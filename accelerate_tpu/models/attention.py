@@ -48,6 +48,32 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def grouped_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """[B,S,N,D] x [B,T,KV,D] -> [B,N,S,T] attention logits; GQA query
+    heads grouped onto their shared KV head (h reads kv head h // group) —
+    the ONE definition of the head-grouping convention for every einsum
+    attention path (model zoo, flash fallback, ring fallback)."""
+    b, s, n, d = q.shape
+    t, kv = k.shape[1], k.shape[2]
+    if n != kv:
+        group = n // kv
+        qg = q.reshape(b, s, kv, group, d)
+        return jnp.einsum("bskgd,btkd->bkgst", qg, k).reshape(b, n, s, t)
+    return jnp.einsum("bsnd,btnd->bnst", q, k)
+
+
+def grouped_output(p: jax.Array, v: jax.Array) -> jax.Array:
+    """[B,N,S,T] probabilities x [B,T,KV,D] values -> [B,S,N,D] (GQA twin
+    of :func:`grouped_scores`)."""
+    b, n, s, t = p.shape
+    kv, d = v.shape[2], v.shape[3]
+    if n != kv:
+        group = n // kv
+        pg = p.reshape(b, kv, group, s, t)
+        return jnp.einsum("bkgst,btkd->bskgd", pg, v).reshape(b, s, n, d)
+    return jnp.einsum("bnst,btnd->bsnd", p, v)
+
+
 def dot_product_attention(
     q: jax.Array,  # [B, S, N, D]
     k: jax.Array,  # [B, T, K, D]
@@ -59,16 +85,9 @@ def dot_product_attention(
 ) -> jax.Array:
     """Grouped-query attention; softmax in fp32 for stability."""
     b, s, n, d = q.shape
-    t, kv = k.shape[1], k.shape[2]
+    t = k.shape[1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
-    if n != kv:
-        group = n // kv
-        q = q.reshape(b, s, kv, group, d)
-        logits = jnp.einsum("bskgd,btkd->bkgst", q * scale, k)
-        logits = logits.reshape(b, n, s, t)
-    else:
-        logits = jnp.einsum("bsnd,btnd->bnst", q * scale, k)
-    logits = logits.astype(jnp.float32)
+    logits = grouped_scores(q * scale, k).astype(jnp.float32)
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     if causal:
@@ -77,10 +96,4 @@ def dot_product_attention(
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    if n != kv:
-        group = n // kv
-        probs_g = probs.reshape(b, kv, group, s, t)
-        out = jnp.einsum("bkgst,btkd->bskgd", probs_g, v).reshape(b, s, n, d)
-    else:
-        out = jnp.einsum("bnst,btnd->bsnd", probs, v)
-    return out
+    return grouped_output(probs, v)

@@ -79,6 +79,7 @@ class _Cfg(NamedTuple):
     has_mask: bool
     has_bias: bool
     bias_batched: bool  # bias leading dim == B (no batch reduction of dbias)
+    has_offsets: bool = False  # global (q_offset, kv_offset) positions (ring)
 
 
 # ---------------------------------------------------------------------------
@@ -87,18 +88,21 @@ class _Cfg(NamedTuple):
 
 
 
-def _split_refs(refs, has_mask, has_bias):
-    """(q, k, v, mask?, limit?, bias?, rest) — shared kernel preamble."""
+def _split_refs(refs, has_mask, has_bias, has_offsets=False):
+    """(q, k, v, mask?, limit?, offsets?, bias?, rest) — shared preamble."""
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
     i = 3
-    mask_ref = limit_ref = bias_ref = None
+    mask_ref = limit_ref = offs_ref = bias_ref = None
     if has_mask:
         mask_ref, limit_ref = refs[i], refs[i + 1]
         i += 2
+    if has_offsets:
+        offs_ref = refs[i]
+        i += 1
     if has_bias:
         bias_ref = refs[i]
         i += 1
-    return q_ref, k_ref, v_ref, mask_ref, limit_ref, bias_ref, refs[i:]
+    return q_ref, k_ref, v_ref, mask_ref, limit_ref, offs_ref, bias_ref, refs[i:]
 
 
 def _block_scores(q_tile, k_tile, scale, bias_tile, causal_pos, penalty):
@@ -134,13 +138,24 @@ def _mask_penalty(mask_ref, start, size):
     return (rows[:1] - 1.0) * -NEG_INF
 
 
-def _fwd_kernel(*refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bias):
-    q_ref, k_ref, v_ref, mask_ref, limit_ref, bias_ref, (o_ref, lse_ref) = _split_refs(
-        refs, has_mask, has_bias
+
+def _nblocks(last_index, block: int):
+    """Blocks covering key indices 0..last_index (0 when negative) — uses
+    truncating lax.div on NON-NEGATIVE operands: jnp's signed floor-div
+    emits sign-fixup ops that Mosaic cannot lower inside manual regions."""
+    covered = jnp.maximum(last_index + 1, 0)
+    return jax.lax.div(covered + jnp.int32(block - 1), jnp.int32(block))
+
+def _fwd_kernel(*refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bias, has_offsets):
+    q_ref, k_ref, v_ref, mask_ref, limit_ref, offs_ref, bias_ref, (o_ref, lse_ref) = _split_refs(
+        refs, has_mask, has_bias, has_offsets
     )
 
     bi = pl.program_id(0)
     iq = pl.program_id(2)
+    # global positions (ring blocks live at an offset into the full sequence)
+    qoff = offs_ref[0, 0] if has_offsets else 0
+    koff = offs_ref[0, 1] if has_offsets else 0
     # keep q/k/v in their native dtype: the dots accumulate in fp32 via
     # preferred_element_type, but bf16 OPERANDS run the MXU at full rate —
     # an fp32 upcast before the dot would quarter the matmul throughput.
@@ -152,14 +167,17 @@ def _fwd_kernel(*refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bi
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
 
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    q_pos = qoff + iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     # dynamic k-block bound: causal limit and/or last valid key of this row
     upper = kv_len // block_k
     if causal:
-        upper = jnp.minimum(upper, (iq * block_q + bq - 1) // block_k + 1)
+        # last attendable LOCAL k index for this q block (can be negative:
+        # the whole k block set is in the future — zero iterations)
+        last_k = qoff - koff + iq * block_q + bq - 1
+        upper = jnp.minimum(_nblocks(last_k, block_k), upper)
     if has_mask:
-        upper = jnp.minimum(upper, limit_ref[bi, 0] // block_k + 1)  # -1 → 0
+        upper = jnp.minimum(upper, _nblocks(limit_ref[bi, 0], block_k))  # -1 → 0
 
     def body(j, carry):
         m, l, acc = carry
@@ -168,7 +186,7 @@ def _fwd_kernel(*refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bi
         s = _block_scores(
             q, k_blk, scale,
             bias_ref[0, 0, :, pl.ds(j * block_k, block_k)] if has_bias else None,
-            (j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1), q_pos)
+            (koff + j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1), q_pos)
             if causal else None,
             _mask_penalty(mask_ref, j * block_k, block_k) if has_mask else None,
         )  # [BQ, BK] fp32
@@ -190,7 +208,25 @@ def _fwd_kernel(*refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bi
     lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, 8))
 
 
-def _flash_forward(q, k, v, mask, limit, bias, cfg: _Cfg):
+
+def _common_operand_specs(cfg: _Cfg, mask, limit, offsets, kv_len, gidx=lambda f: f):
+    """(in_specs, args) for the optional mask/limit/offsets operands — ONE
+    definition for the forward and both backward passes, in _split_refs
+    order (a missed branch here fails only at Mosaic lowering). The bias
+    operand stays per-site: its block geometry differs between the q-major
+    passes and the dkv pass."""
+    specs, args = [], []
+    if cfg.has_mask:
+        specs.append(pl.BlockSpec((1, 8, kv_len), gidx(lambda bi, ni, qi: (bi, 0, 0)), memory_space=pltpu.VMEM))
+        specs.append(pl.BlockSpec(limit.shape, gidx(lambda bi, ni, qi: (0, 0)), memory_space=pltpu.SMEM))
+        args += [mask, limit]
+    if cfg.has_offsets:
+        specs.append(pl.BlockSpec(offsets.shape, gidx(lambda bi, ni, qi: (0, 0)), memory_space=pltpu.SMEM))
+        args.append(offsets)
+    return specs, args
+
+
+def _flash_forward(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
     b, n, sq, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
@@ -207,14 +243,9 @@ def _flash_forward(q, k, v, mask, limit, bias, cfg: _Cfg):
         kv_spec,
     ]
     args = [q, k, v]
-    if cfg.has_mask:
-        in_specs.append(
-            pl.BlockSpec((1, 8, kv_len), lambda bi, ni, qi: (bi, 0, 0), memory_space=pltpu.VMEM)
-        )
-        in_specs.append(
-            pl.BlockSpec(limit.shape, lambda bi, ni, qi: (0, 0), memory_space=pltpu.SMEM)
-        )
-        args += [mask, limit]
+    opt_specs, opt_args = _common_operand_specs(cfg, mask, limit, offsets, kv_len)
+    in_specs += opt_specs
+    args += opt_args
     if cfg.has_bias:
         bb = bias.shape[0]
         in_specs.append(
@@ -229,6 +260,7 @@ def _flash_forward(q, k, v, mask, limit, bias, cfg: _Cfg):
         functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k, scale=cfg.scale,
             kv_len=kv_len, causal=cfg.causal, has_mask=cfg.has_mask, has_bias=cfg.has_bias,
+            has_offsets=cfg.has_offsets,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -252,10 +284,10 @@ def _flash_forward(q, k, v, mask, limit, bias, cfg: _Cfg):
 
 def _bwd_dq_kernel(
     *refs, block_q, block_k, scale, kv_len, causal, has_mask, has_bias,
-    emit_dbias, bias_reduce,
+    has_offsets, emit_dbias, bias_reduce,
 ):
-    q_ref, k_ref, v_ref, mask_ref, limit_ref, bias_ref, rest = _split_refs(
-        refs, has_mask, has_bias
+    q_ref, k_ref, v_ref, mask_ref, limit_ref, offs_ref, bias_ref, rest = _split_refs(
+        refs, has_mask, has_bias, has_offsets
     )
     do_ref, lse_ref, delta_ref, dq_ref = rest[0], rest[1], rest[2], rest[3]
     dbias_ref = rest[4] if emit_dbias else None
@@ -265,6 +297,8 @@ def _bwd_dq_kernel(
     # CONSECUTIVE grid steps, so the batch goes innermost)
     iq = pl.program_id(1 if bias_reduce else 2)
     bi = pl.program_id(2) if bias_reduce else pl.program_id(0)
+    qoff = offs_ref[0, 0] if has_offsets else 0
+    koff = offs_ref[0, 1] if has_offsets else 0
 
     # native-dtype operands on every dot (bf16 MXU rate), fp32 accumulation
     q = q_ref[0, 0]  # [BQ, D]
@@ -273,7 +307,7 @@ def _bwd_dq_kernel(
     delta = delta_ref[0, 0][:, :1]
     bq, d = q.shape
 
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    q_pos = qoff + iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
     dq = jnp.zeros((bq, d), jnp.float32)
 
     if emit_dbias and bias_reduce:
@@ -286,9 +320,10 @@ def _bwd_dq_kernel(
 
     upper = kv_len // block_k
     if causal:
-        upper = jnp.minimum(upper, (iq * block_q + bq - 1) // block_k + 1)
+        last_k = qoff - koff + iq * block_q + bq - 1
+        upper = jnp.minimum(_nblocks(last_k, block_k), upper)
     if has_mask:
-        upper = jnp.minimum(upper, limit_ref[bi, 0] // block_k + 1)
+        upper = jnp.minimum(upper, _nblocks(limit_ref[bi, 0], block_k))
 
     def body(j, dq):
         k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
@@ -296,7 +331,7 @@ def _bwd_dq_kernel(
         s = _block_scores(
             q, k_blk, scale,
             bias_ref[0, 0, :, pl.ds(j * block_k, block_k)] if has_bias else None,
-            (j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1), q_pos)
+            (koff + j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1), q_pos)
             if causal else None,
             _mask_penalty(mask_ref, j * block_k, block_k) if has_mask else None,
         )
@@ -321,21 +356,24 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    *refs, block_q, block_k, scale, q_len, causal, has_mask, has_bias, group,
+    *refs, block_q, block_k, scale, q_len, causal, has_mask, has_bias,
+    has_offsets, group,
 ):
-    q_ref, k_ref, v_ref, mask_ref, limit_ref, bias_ref, rest = _split_refs(
-        refs, has_mask, has_bias
+    q_ref, k_ref, v_ref, mask_ref, limit_ref, offs_ref, bias_ref, rest = _split_refs(
+        refs, has_mask, has_bias, has_offsets
     )
     do_ref, lse_ref, delta_ref, dk_ref, dv_ref = rest
 
     bi = pl.program_id(0)
     ik = pl.program_id(2)
+    qoff = offs_ref[0, 0] if has_offsets else 0
+    koff = offs_ref[0, 1] if has_offsets else 0
     # native-dtype operands on every dot (bf16 MXU rate), fp32 accumulation
     k_blk = k_ref[0, 0]  # [BK, D]
     v_blk = v_ref[0, 0]
     bk, d = k_blk.shape
 
-    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    k_pos = koff + ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
 
@@ -343,7 +381,11 @@ def _bwd_dkv_kernel(
 
     # q-block loop bounds: causal — q blocks strictly above this k block see
     # none of it; mask — a k block past the last valid key contributes nothing
-    lower = (ik * block_k) // block_q if causal else 0
+    if causal:
+        first_q = (koff - qoff + ik * block_k) if has_offsets else ik * block_k
+        lower = jax.lax.div(jnp.maximum(first_q, 0), jnp.int32(block_q))
+    else:
+        lower = 0
     upper = q_len // block_q
     if has_mask:
         upper = jnp.where(ik * block_k <= limit_ref[bi, 0], upper, lower)
@@ -360,7 +402,7 @@ def _bwd_dkv_kernel(
             s = _block_scores(
                 q, k_blk, scale,
                 bias_ref[0, g, pl.ds(jq * block_q, block_q), :] if has_bias else None,
-                (k_pos, jq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+                (k_pos, qoff + jq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
                 if causal else None,
                 penalty,
             )  # [BQ, BK] fp32
@@ -387,8 +429,8 @@ def _bwd_dkv_kernel(
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, cfg: _Cfg):
-    q, k, v, mask, limit, bias, out, lse = res
+def _flash_backward(res, g, cfg: _Cfg, dlse=None):
+    q, k, v, mask, limit, offsets, bias, out, lse = res
     b, n, sq, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
@@ -396,6 +438,11 @@ def _flash_backward(res, g, cfg: _Cfg):
     block_q, block_k = cfg.bwd_block_q, cfg.bwd_block_k
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)  # [B, N, S]
+    if dlse is not None:
+        # lse is a USED output (the ring merge weights blocks by it):
+        # dL/ds_ij = p_ij (dp_ij - delta_i + dlse_i) — absorbing dlse into
+        # the delta term keeps the kernels untouched
+        delta = delta - dlse
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 8))
 
     emit_dbias = cfg.has_bias
@@ -419,10 +466,9 @@ def _flash_backward(res, g, cfg: _Cfg):
 
     in_specs = [q_spec, kv_full, kv_full]
     args = [q, k, v]
-    if cfg.has_mask:
-        in_specs.append(pl.BlockSpec((1, 8, kv_len), gidx(lambda bi, ni, qi: (bi, 0, 0)), memory_space=pltpu.VMEM))
-        in_specs.append(pl.BlockSpec(limit.shape, gidx(lambda bi, ni, qi: (0, 0)), memory_space=pltpu.SMEM))
-        args += [mask, limit]
+    opt_specs, opt_args = _common_operand_specs(cfg, mask, limit, offsets, kv_len, gidx)
+    in_specs += opt_specs
+    args += opt_args
     if cfg.has_bias:
         bb = bias.shape[0]
         in_specs.append(
@@ -452,7 +498,8 @@ def _flash_backward(res, g, cfg: _Cfg):
         functools.partial(
             _bwd_dq_kernel, block_q=block_q, block_k=block_k, scale=cfg.scale,
             kv_len=kv_len, causal=cfg.causal, has_mask=cfg.has_mask,
-            has_bias=cfg.has_bias, emit_dbias=emit_dbias, bias_reduce=bias_reduce,
+            has_bias=cfg.has_bias, has_offsets=cfg.has_offsets,
+            emit_dbias=emit_dbias, bias_reduce=bias_reduce,
         ),
         grid=grid_dq,
         in_specs=in_specs,
@@ -474,10 +521,9 @@ def _flash_backward(res, g, cfg: _Cfg):
 
     in_specs2 = [qhead_group, kv_blk_spec, kv_blk_spec]
     args2 = [q, k, v]
-    if cfg.has_mask:
-        in_specs2.append(pl.BlockSpec((1, 8, kv_len), lambda bi, ki, kbi: (bi, 0, 0), memory_space=pltpu.VMEM))
-        in_specs2.append(pl.BlockSpec(limit.shape, lambda bi, ki, kbi: (0, 0), memory_space=pltpu.SMEM))
-        args2 += [mask, limit]
+    opt_specs, opt_args = _common_operand_specs(cfg, mask, limit, offsets, kv_len)
+    in_specs2 += opt_specs
+    args2 += opt_args
     if cfg.has_bias:
         bb = bias.shape[0]
         in_specs2.append(
@@ -495,7 +541,7 @@ def _flash_backward(res, g, cfg: _Cfg):
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, block_k=block_k, scale=cfg.scale,
             q_len=sq, causal=cfg.causal, has_mask=cfg.has_mask,
-            has_bias=cfg.has_bias, group=group,
+            has_bias=cfg.has_bias, has_offsets=cfg.has_offsets, group=group,
         ),
         grid=(b, kv_heads, kv_len // block_k),
         in_specs=in_specs2,
@@ -519,14 +565,14 @@ def _float0_like(x):
     return np.zeros(x.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _flash_attention_bnsd(q, k, v, mask, limit, bias, cfg: _Cfg):
-    out, _ = _flash_forward(q, k, v, mask, limit, bias, cfg)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _flash_attention_bnsd(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
+    out, _ = _flash_forward(q, k, v, mask, limit, offsets, bias, cfg)
     return out
 
 
-def _fwd_rule(q, k, v, mask, limit, bias, cfg: _Cfg):
-    out, lse = _flash_forward(q, k, v, mask, limit, bias, cfg)
+def _fwd_rule(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
+    out, lse = _flash_forward(q, k, v, mask, limit, offsets, bias, cfg)
     # named for remat policies: under "save_flash" (the activation-checkpointing
     # default) the backward keeps out/lse instead of re-running the forward
     # kernel — q/k/v rebuild from cheap projections, the flash pass does not
@@ -534,21 +580,52 @@ def _fwd_rule(q, k, v, mask, limit, bias, cfg: _Cfg):
 
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, mask, limit, bias, out, lse)
+    return out, (q, k, v, mask, limit, offsets, bias, out, lse)
 
 
 def _bwd_rule(cfg: _Cfg, res, g):
     dq, dk, dv, dbias = _flash_backward(res, g, cfg)
-    mask, limit = res[3], res[4]
+    mask, limit, offsets = res[3], res[4], res[5]
     return (
         dq, dk, dv,
         None if mask is None else _float0_like(mask),
         None if limit is None else _float0_like(limit),
+        None if offsets is None else _float0_like(offsets),
         dbias,
     )
 
 
 _flash_attention_bnsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ring-block entry: lse is a REAL output (the ring merge weights blocks by
+# it), so this variant's vjp also consumes the lse cotangent
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _flash_attention_lse_bnsd(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
+    return _flash_forward(q, k, v, mask, limit, offsets, bias, cfg)
+
+
+def _lse_fwd_rule(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
+    out, lse = _flash_forward(q, k, v, mask, limit, offsets, bias, cfg)
+    return (out, lse), (q, k, v, mask, limit, offsets, bias, out, lse)
+
+
+def _lse_bwd_rule(cfg: _Cfg, res, gs):
+    do, dlse8 = gs
+    # the wrapper exposes lse as [..., 0] of the 8-sublane storage, so the
+    # cotangent rides column 0; summing is exact for any consumer pattern
+    dq, dk, dv, dbias = _flash_backward(res, do, cfg, dlse=dlse8.sum(axis=-1))
+    mask, limit, offsets = res[3], res[4], res[5]
+    return (
+        dq, dk, dv,
+        None if mask is None else _float0_like(mask),
+        None if limit is None else _float0_like(limit),
+        None if offsets is None else _float0_like(offsets),
+        dbias,
+    )
+
+
+_flash_attention_lse_bnsd.defvjp(_lse_fwd_rule, _lse_bwd_rule)
 
 
 def _fit_block(block: int, s: int) -> int:
@@ -616,6 +693,11 @@ def flash_attention(
         return dot_product_attention(q, k, v, mask=mask, causal=causal, scale=scale, bias=bias)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if bias is not None and bias.shape[0] not in (1, b):
+        # the kernel's index maps only know broadcast-or-batched; anything
+        # else would silently read bias[0] everywhere and leave dbias rows
+        # unwritten (the einsum path would raise a broadcast error)
+        raise ValueError(f"bias batch dim must be 1 or {b}, got {bias.shape[0]}")
     mask = limit = None
     if kv_mask is not None:
         mask, limit = _mask_limit(kv_mask)
@@ -625,9 +707,95 @@ def flash_attention(
         bias_batched=bias is not None and bias.shape[0] == b,
     )
     out = _flash_attention_bnsd(
-        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), mask, limit, bias, cfg
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), mask, limit, None, bias, cfg
     )
     return out.swapaxes(1, 2)
+
+
+def _einsum_attention_lse(q, k, v, kv_mask, causal, q_offset, kv_offset, scale):
+    """Exact fallback with the block entry's (out, lse) contract — same merge
+    semantics as the kernel (fully-masked rows: out 0, lse very negative).
+    Head grouping rides models.attention.grouped_scores/grouped_output, the
+    zoo's single source of truth for the GQA convention."""
+    from ..models.attention import grouped_output, grouped_scores
+
+    b, s, n, d = q.shape
+    t = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scores = grouped_scores(q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = (0 if q_offset is None else q_offset) + jnp.arange(s)
+        k_pos = (0 if kv_offset is None else kv_offset) + jnp.arange(t)
+        scores = jnp.where(k_pos[None, :] <= q_pos[:, None], scores, NEG_INF)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :] != 0, scores, NEG_INF)
+    m = jnp.maximum(jnp.max(scores, axis=-1), M_INIT)  # [B,N,S]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = grouped_output((p / l_safe[..., None]).astype(q.dtype), v)
+    lse = (m + jnp.log(l_safe)).transpose(0, 2, 1)  # [B, S, N]
+    return out, lse
+
+
+def flash_attention_block(
+    q: jax.Array,  # [B, S, N, D]
+    k: jax.Array,  # [B, T, KV, D]
+    v: jax.Array,  # [B, T, KV, D]
+    kv_mask: Optional[jax.Array] = None,  # [B, T] key validity
+    *,
+    causal: bool = False,
+    q_offset=None,  # global position of q[.., 0] (traced ok — ring rotation)
+    kv_offset=None,  # global position of k[.., 0]
+    block_q: int = 256,
+    block_k: int = 512,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
+    scale: Optional[float] = None,
+):
+    """One attention BLOCK with online-softmax stats: ``(out, lse)`` where
+    ``out`` [B, S, N, D] is the normalized block attention and ``lse``
+    [B, S, N] fp32 its log-sum-exp — exactly what a ring/flash-decoding
+    merge needs: a block contributes ``(numerator=out, max=lse, sum=1)``.
+    Both outputs are differentiable (the merge weights blocks by lse).
+
+    ``causal`` compares GLOBAL positions ``q_offset + i <= kv_offset + j``
+    (dynamic offsets — the ring's rotation index is traced), so one compiled
+    kernel serves diagonal, past (fully attended) and future (skipped via a
+    zero-trip k-block loop) ring blocks. Falls back to an einsum with
+    identical semantics off-TPU or for untileable shapes.
+    """
+    b, s, n, d = q.shape
+    t = k.shape[1]
+    bq, bk = _fit_block(block_q, s), _fit_block(block_k, t)
+    bbq = _fit_block(bwd_block_q or BWD_BLOCK_Q, s)
+    bbk = _fit_block(bwd_block_k or BWD_BLOCK_K, t)
+    in_manual_region = bool(getattr(getattr(q, "aval", None), "vma", None))
+    untileable = any(x % 128 for x in (bq, bk, bbq, bbk)) or s % bq or t % bk or s % bbq or t % bbk
+    if (in_manual_region and _interpret()) or untileable:
+        return _einsum_attention_lse(q, k, v, kv_mask, causal, q_offset, kv_offset, scale)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    mask = limit = None
+    if kv_mask is not None:
+        mask, limit = _mask_limit(kv_mask)
+    offsets = None
+    has_offsets = causal and (q_offset is not None or kv_offset is not None)
+    if has_offsets:
+        offsets = jnp.stack([
+            jnp.asarray(0 if q_offset is None else q_offset, jnp.int32),
+            jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32),
+        ]).reshape(1, 2)
+    cfg = _Cfg(
+        block_q=bq, block_k=bk, bwd_block_q=bbq, bwd_block_k=bbk, scale=scale,
+        causal=causal, has_mask=mask is not None, has_bias=False,
+        bias_batched=False, has_offsets=has_offsets,
+    )
+    out, lse8 = _flash_attention_lse_bnsd(
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), mask, limit, offsets, None, cfg
+    )
+    return out.swapaxes(1, 2), lse8[..., 0].transpose(0, 2, 1)
 
 
 # backward tile defaults from the round-4 v5e sweep at seq 4096 (bs=8, 12

@@ -18,8 +18,11 @@ the sequence axis is a first-class mesh dimension:
 Memory per device: O(S/n · S/n) score blocks instead of O(S²) — sequence
 length scales linearly with the ring size.
 
-The block kernel is einsum-based (XLA fuses it well); a Pallas splash kernel
-can replace `_block_attn` without touching the ring logic.
+The per-block math runs the Pallas flash kernel on TPU
+(ops.flash_attention.flash_attention_block — offset-causal, masked, with a
+differentiable lse output) and an identical-semantics einsum off-TPU: each
+block contributes ``(numerator=out·1, max=lse, sum=1)`` to the online merge,
+so the ring is exact either way.
 """
 
 from __future__ import annotations
@@ -31,71 +34,39 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention_block
 from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_FSDP, MESH_AXIS_SEQUENCE, MESH_AXIS_TENSOR
 
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, mask):
-    """One KV-block's contribution with running-softmax stats.
-
-    q [B,S,N,D], k/v [B,T,KV,D] (unexpanded GQA), mask [B,S,T] bool
-    (True = attend). Returns (numerator [B,S,N,D] fp32, row_max [B,S,N],
-    row_sum [B,S,N]).
-    """
-    b, s_q, n, d = q.shape
-    t, kv = k.shape[1], k.shape[2]
-    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
-    if n != kv:
-        g = n // kv
-        qg = q.reshape(b, s_q, kv, g, d)
-        scores = jnp.einsum("bskgd,btkd->bkgst", qg * scale, k).reshape(b, n, s_q, t)
-    else:
-        scores = jnp.einsum("bsnd,btnd->bnst", q * scale, k)
-    scores = scores.astype(jnp.float32)
-    scores = jnp.where(mask[:, None], scores, NEG_INF)
-    m = jnp.max(scores, axis=-1)  # [B,N,S]
-    m_safe = jnp.maximum(m, NEG_INF / 2)  # fully-masked rows: keep exp finite
-    p = jnp.exp(scores - m_safe[..., None])
-    p = jnp.where(mask[:, None], p, 0.0)
-    l = jnp.sum(p, axis=-1)  # [B,N,S]
-    if n != kv:
-        g = n // kv
-        pg = p.reshape(b, kv, g, s_q, t)
-        o = jnp.einsum("bkgst,btkd->bskgd", pg.astype(q.dtype), v).reshape(b, s_q, n, d)
-    else:
-        o = jnp.einsum("bnst,btnd->bsnd", p.astype(q.dtype), v)
-    return o.astype(jnp.float32), jnp.transpose(m_safe, (0, 2, 1)), jnp.transpose(l, (0, 2, 1))
-
-
 def _ring_attention_local(q, k, v, kv_valid, axis_name: str, causal: bool):
     """Body run per sequence shard inside shard_map.
 
-    kv_valid [B, S_local] bool: key positions that are real (not padding).
+    kv_valid [B, S_local] bool or None: key positions that are real.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, nh, d = q.shape
 
-    q_pos = idx * s_local + jnp.arange(s_local)
     perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def block_mask(r):
-        src = (idx - r) % n  # whose K/V block we currently hold
-        kv_pos = src * s_local + jnp.arange(s_local)
-        if causal:
-            return kv_pos[None, :] <= q_pos[:, None]  # [S,T]
-        return jnp.ones((s_local, s_local), bool)
+    q_offset = idx * s_local
 
     def accumulate(carry, r, k_cur, v_cur, valid_cur):
         o, m, l = carry
-        mask = block_mask(r)[None] & valid_cur[:, None, :]  # [B,S,T]
-        o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, mask)
-        m_new = jnp.maximum(m, m_blk)
+        src = (idx - r) % n  # whose K/V block we currently hold
+        # the block kernel owns ALL masking: offset-causal positions (future
+        # blocks cost a zero-trip loop) + rotated key validity. Its (out,
+        # lse) is a normalized partial softmax: merge as (out, lse, 1).
+        o_blk, lse_blk = flash_attention_block(
+            q, k_cur, v_cur, valid_cur, causal=causal,
+            q_offset=q_offset, kv_offset=src * s_local,
+        )
+        m_new = jnp.maximum(m, lse_blk)
         corr_old = jnp.exp(m - m_new)
-        corr_blk = jnp.exp(m_blk - m_new)
-        o = o * corr_old[..., None] + o_blk * corr_blk[..., None]
-        l = l * corr_old + l_blk * corr_blk
+        corr_blk = jnp.exp(lse_blk - m_new)
+        o = o * corr_old[..., None] + o_blk.astype(jnp.float32) * corr_blk[..., None]
+        l = l * corr_old + corr_blk
         return o, m_new, l
 
     def step(carry, r):
@@ -103,7 +74,7 @@ def _ring_attention_local(q, k, v, kv_valid, axis_name: str, causal: bool):
         # dispatch the rotation first so the hop overlaps the block compute
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
+        valid_next = None if valid_cur is None else jax.lax.ppermute(valid_cur, axis_name, perm)
         o, m, l = accumulate((o, m, l), r, k_cur, v_cur, valid_cur)
         return (o, m, l, k_next, v_next, valid_next), None
 
@@ -113,9 +84,10 @@ def _ring_attention_local(q, k, v, kv_valid, axis_name: str, causal: bool):
     vma = getattr(q.aval, "vma", None)
     if vma:
         o0, m0, l0 = (jax.lax.pcast(x, tuple(vma), to="varying") for x in (o0, m0, l0))
-        missing = tuple(set(vma) - set(getattr(kv_valid.aval, "vma", ()) or ()))
-        if missing:  # e.g. an all-ones mask built inside the manual region
-            kv_valid = jax.lax.pcast(kv_valid, missing, to="varying")
+        if kv_valid is not None:
+            missing = tuple(set(vma) - set(getattr(kv_valid.aval, "vma", ()) or ()))
+            if missing:  # e.g. an all-ones mask built inside the manual region
+                kv_valid = jax.lax.pcast(kv_valid, missing, to="varying")
 
     if n > 1:
         # n-1 rotating rounds, then a final round with no wasted hop
@@ -140,10 +112,7 @@ def make_local_ring_attention(
     :func:`make_ring_attention`."""
 
     def attn(q, k, v, kv_mask=None):
-        if kv_mask is None:
-            kv_valid = jnp.ones(q.shape[:2], bool)
-        else:
-            kv_valid = kv_mask.astype(bool)
+        kv_valid = None if kv_mask is None else kv_mask.astype(bool)
         return _ring_attention_local(q, k, v, kv_valid, axis_name=axis_name, causal=causal)
 
     return attn

@@ -354,3 +354,103 @@ def test_prepare_model_wires_noncausal_hook(monkeypatch):
     Accelerator().prepare_model(model)
     assert wired["args"][1] is False  # bert: non-causal kernel
     assert model.attention_fn is not None
+
+
+# ---------------------------------------------------------------------------
+# ring-block entry: offset-causal (out, lse) blocks and their merge
+# ---------------------------------------------------------------------------
+
+
+def _merge_blocks(pieces):
+    """Online-softmax merge of normalized (out, lse) blocks (the ring rule)."""
+    o = m = l = None
+    for out, lse in pieces:
+        if o is None:
+            o, m, l = out.astype(jnp.float32), lse, jnp.ones_like(lse)
+            continue
+        m_new = jnp.maximum(m, lse)
+        co, cb = jnp.exp(m - m_new), jnp.exp(lse - m_new)
+        o = o * co[..., None] + out.astype(jnp.float32) * cb[..., None]
+        l = l * co + cb
+        m = m_new
+    return (o / jnp.maximum(l[..., None], 1e-30)).astype(pieces[0][0].dtype)
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_block_merge_reconstructs_causal_attention(masked):
+    """Ring simulation: the sequence split into 2 KV halves, each attended
+    via flash_attention_block at its global offset; the lse merge must
+    reconstruct full causal attention exactly."""
+    from accelerate_tpu.ops.flash_attention import flash_attention_block
+
+    s = 256
+    q, k, v = _qkv(b=2, s=s, n=2, kv=2, d=64, seed=12)
+    mask = jnp.asarray([[1] * s, [1] * 170 + [0] * (s - 170)], jnp.int32) if masked else None
+    half = s // 2
+    # shard 1's query block (positions half..s-1) sees k-half0 fully (past)
+    # and k-half1 causally (diagonal)
+    q1 = q[:, half:]
+    pieces = [
+        flash_attention_block(
+            q1, k[:, :half], v[:, :half], None if mask is None else mask[:, :half],
+            causal=True, q_offset=half, kv_offset=0, block_q=128, block_k=128,
+        ),
+        flash_attention_block(
+            q1, k[:, half:], v[:, half:], None if mask is None else mask[:, half:],
+            causal=True, q_offset=half, kv_offset=half, block_q=128, block_k=128,
+        ),
+    ]
+    got = _merge_blocks(pieces)
+    mask4 = None if mask is None else mask[:, None, None, :].astype(bool)
+    want = dot_product_attention(q, k, v, mask=mask4, causal=True)[:, half:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    # shard 0's query block sees k-half1 NOT at all (future: zero-trip loop)
+    q0 = q[:, :half]
+    future_out, future_lse = flash_attention_block(
+        q0, k[:, half:], v[:, half:], None if mask is None else mask[:, half:],
+        causal=True, q_offset=0, kv_offset=half, block_q=128, block_k=128,
+    )
+    np.testing.assert_array_equal(np.asarray(future_out), 0.0)
+    assert (np.asarray(future_lse) < -1e28).all()  # merge weight exp(lse)→0
+
+
+def test_block_merge_gradients_flow_through_lse():
+    """The merge weights blocks by lse — its cotangent must reach q/k/v
+    (delta' = delta - dlse in the backward kernels)."""
+    from accelerate_tpu.ops.flash_attention import flash_attention_block
+
+    s = 256
+    q, k, v = _qkv(b=1, s=s, n=2, kv=2, d=64, seed=13)
+    half = s // 2
+
+    def loss_blocks(q, k, v):
+        q1 = q[:, half:]
+        pieces = [
+            flash_attention_block(q1, k[:, :half], v[:, :half], causal=True,
+                                  q_offset=half, kv_offset=0, block_q=128, block_k=128),
+            flash_attention_block(q1, k[:, half:], v[:, half:], causal=True,
+                                  q_offset=half, kv_offset=half, block_q=128, block_k=128),
+        ]
+        return (_merge_blocks(pieces).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out = dot_product_attention(q, k, v, causal=True)[:, half:]
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(loss_blocks, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_block_noncausal_matches_plain():
+    from accelerate_tpu.ops.flash_attention import flash_attention_block
+
+    q, k, v = _qkv(b=2, s=128, n=4, kv=2, d=64, seed=14)  # GQA too
+    out, lse = flash_attention_block(q, k, v, causal=False, block_q=128, block_k=128)
+    want = dot_product_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5)
+    assert lse.shape == (2, 128, 4) and np.isfinite(np.asarray(lse)).all()
