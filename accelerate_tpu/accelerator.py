@@ -875,7 +875,12 @@ class Accelerator:
             if remat_policy is not None:
                 fn = jax.checkpoint(fn, policy=remat_policy)
             loss = fn(cast_floating(params, policy.compute_dtype), cast_floating(batch, policy.compute_dtype))
-            return loss.astype(jnp.float32) * scale
+            loss = loss.astype(jnp.float32)
+            # scale is None (STATIC) without an fp16 scaler: a traced scale of
+            # 1.0 cannot be folded by XLA, and the matching grads/scale divide
+            # below would read+write the whole gradient tree every step
+            # (~0.9 GB on bert-base ≈ 3 ms — the round-2..4 bert regression)
+            return loss if scale is None else loss * scale
 
         def step_impl(params, opt_state, batch, scale, growth_tracker):
             if num_micro > 1:
@@ -893,13 +898,19 @@ class Accelerator:
                 loss = loss / num_micro
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, batch, scale)
-            grads = jax.tree.map(lambda g: g / scale, grads)
+            if scale is not None:
+                grads = jax.tree.map(lambda g: g / scale, grads)
             grads = clip_by_value(grads, clip_grad_value)
-            grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
+            # the global norm is a full gradient-tree reduction — compute it
+            # only for consumers (the clip, or the scaler's finite check)
+            gnorm = None
+            if clip_grad_norm is not None or scaler_cfg is not None:
+                grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
 
             # unscale the reported loss with the scale it was computed under,
             # before the scaler bookkeeping below mutates `scale`
-            loss = loss / scale
+            if scale is not None:
+                loss = loss / scale
             params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
                 tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
             )
@@ -913,8 +924,11 @@ class Accelerator:
         jitted = jax.jit(step_impl, donate_argnums=(0, 1))
 
         def step(batch):
-            scale = optimizer.scale if optimizer.scale is not None else jnp.float32(1.0)
-            growth = optimizer.growth_tracker if optimizer.growth_tracker is not None else jnp.int32(0)
+            # no scaler → scale stays a STATIC None (empty pytree through jit):
+            # every scaling op is elided at trace time instead of shipping a
+            # runtime 1.0 the compiler cannot fold
+            scale = optimizer.scale if scaler_cfg is not None else None
+            growth = optimizer.growth_tracker if scaler_cfg is not None else None
             opt_state_in = optimizer.opt_state
             if optimizer.cpu_offload:
                 opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)

@@ -191,8 +191,22 @@ class AcceleratedOptimizer:
         scaler_cfg = self.scaler
 
         def update(params, opt_state, grads, accum_count, scale, growth_tracker):
-            denom = accum_count.astype(jnp.float32) * (scale if use_scaler else jnp.float32(1.0))
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
+            # accum_count is STATIC (jit static_argnums) and scale is a static
+            # None without a scaler: the unscale divide either folds into the
+            # optimizer's elementwise chain (constant divisor) or disappears —
+            # a traced 1.0 here cost a full gradient-tree read+write per step.
+            # Cost of the static count: one extra compile per DISTINCT count
+            # (cached thereafter) — in practice two values, the configured
+            # window and the final short bundle of an indivisible epoch
+            if use_scaler:
+                denom = float(accum_count) * scale
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
+            elif accum_count != 1:
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / float(accum_count), grads
+                )
+            else:
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             grads = clip_by_value(grads, clip_value)
             grads, gnorm = clip_by_global_norm(grads, clip_norm)
             params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
@@ -208,7 +222,7 @@ class AcceleratedOptimizer:
             opt_state = jax.lax.with_sharding_constraint(opt_state, self._opt_state_device_shardings)
             return params, opt_state, scale, growth_tracker, skipped, gnorm
 
-        return jax.jit(update, donate_argnums=(0, 1, 2))
+        return jax.jit(update, donate_argnums=(0, 1, 2), static_argnums=(3,))
 
     def step(self) -> None:
         if not self.gradient_state.sync_gradients or self._grads is None:
@@ -220,8 +234,6 @@ class AcceleratedOptimizer:
             # itself stays all-device: mixing memory spaces inside a traced
             # program is rejected / trips the SPMD partitioner)
             self.opt_state = jax.device_put(self.opt_state, self._opt_state_device_shardings)
-        scale = self.scale if self.scale is not None else jnp.float32(1.0)
-        growth = self.growth_tracker if self.growth_tracker is not None else jnp.int32(0)
         (
             self._box.value,
             self.opt_state,
@@ -230,7 +242,8 @@ class AcceleratedOptimizer:
             self._skipped,
             self._last_grad_norm,
         ) = self._update_fn(
-            self._box.value, self.opt_state, self._grads, jnp.int32(self._accum_count), scale, growth
+            self._box.value, self.opt_state, self._grads, int(self._accum_count),
+            self.scale, self.growth_tracker,
         )
         if self.scaler is not None:
             self.scale, self.growth_tracker = scale, growth
