@@ -8,6 +8,15 @@ simply *the same `accelerate-tpu launch` command executed on every worker* —
 no xla_dist server, no rendezvous flags. The fan-out transport is
 `gcloud compute tpus tpu-vm ssh --worker=all` (what `tpu-config` also uses,
 reference commands/tpu.py:90-157).
+
+Supervision (torchrun-elastic analogue, reference commands/launch.py:693-726
+--monitor_interval/--max_restarts): with ``--num_workers`` the launcher runs
+one ssh per worker and MONITORS them — a worker exiting nonzero (or silent
+past ``--heartbeat_timeout``) kills the rest of the job loudly instead of
+leaving the surviving hosts hung in the jax.distributed rendezvous, and
+``--restart_on_failure N`` relaunches the whole job up to N times. Without
+``--num_workers`` the single ``--worker=all`` fan-out is kept (no
+supervision — gcloud multiplexes every host through one process).
 """
 
 from __future__ import annotations
@@ -15,6 +24,9 @@ from __future__ import annotations
 import re
 import shlex
 import subprocess
+import sys
+import threading
+import time
 
 
 def register_subcommand(subparsers):
@@ -39,6 +51,22 @@ def register_subcommand(subparsers):
     )
     parser.add_argument("--mixed_precision", default=None)
     parser.add_argument("--num_processes", type=int, default=None, help="Total host count (optional; auto-detected on pods)")
+    parser.add_argument(
+        "--num_workers", type=int, default=None,
+        help="Worker (host) count: enables per-worker supervision — one ssh "
+        "per worker, exit-code propagation, dead-host detection",
+    )
+    parser.add_argument(
+        "--restart_on_failure", type=int, default=0, metavar="N",
+        help="Relaunch the whole job up to N times when a worker fails "
+        "(needs --num_workers)",
+    )
+    parser.add_argument(
+        "--heartbeat_timeout", type=float, default=0.0, metavar="SECONDS",
+        help="Declare a worker dead when it prints nothing for this long "
+        "(0 = disabled; needs --num_workers). Training loops that log "
+        "per-step keep this armed cheaply.",
+    )
     from .launch import argparse_remainder
 
     parser.add_argument("training_script")
@@ -91,10 +119,129 @@ def build_gcloud_ssh_cmd(tpu_name: str, tpu_zone: str, command: str, worker: str
     return cmd
 
 
+class _Worker:
+    """One supervised worker process: output is pumped to our stdout with a
+    ``[worker i]`` prefix, and every line arms the heartbeat."""
+
+    def __init__(self, index: int, proc):
+        self.index = index
+        self.proc = proc
+        self.last_activity = time.monotonic()
+        self._pump = None
+        if getattr(proc, "stdout", None) is not None:
+            self._pump = threading.Thread(target=self._pump_output, daemon=True)
+            self._pump.start()
+
+    def _pump_output(self):
+        for line in self.proc.stdout:
+            self.last_activity = time.monotonic()
+            sys.stdout.write(f"[worker {self.index}] {line}")
+        self.proc.stdout.close()
+
+    def poll(self):
+        return self.proc.poll()
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+
+def supervise(
+    spawn,
+    num_workers: int,
+    restarts: int = 0,
+    heartbeat_timeout: float = 0.0,
+    poll_interval: float = 1.0,
+) -> int:
+    """Run ``spawn(i) -> Popen`` for every worker and monitor the fleet.
+
+    A worker exiting nonzero — or printing nothing for ``heartbeat_timeout``
+    seconds — fails the ATTEMPT: the remaining workers are killed (they would
+    otherwise hang forever in the collective rendezvous waiting for the dead
+    host) and, with ``restarts`` left, the whole fleet relaunches. Per-worker
+    exit codes are reported; the job's exit code is the first failing
+    worker's (124 for a heartbeat kill).
+    """
+    attempt = 0
+    while True:
+        attempt += 1
+        workers = [_Worker(i, spawn(i)) for i in range(num_workers)]
+        failed = None  # (index, returncode, reason)
+        while failed is None:
+            codes = [w.poll() for w in workers]
+            for w, code in zip(workers, codes):
+                if code is not None and code != 0:
+                    failed = (w.index, code, f"exit code {code}")
+                    break
+            if failed is None and all(code == 0 for code in codes):
+                return 0
+            if failed is None and heartbeat_timeout > 0:
+                now = time.monotonic()
+                for w, code in zip(workers, codes):
+                    if code is None and now - w.last_activity > heartbeat_timeout:
+                        failed = (w.index, 124, f"silent for {heartbeat_timeout:.0f}s")
+                        break
+            if failed is None:
+                time.sleep(poll_interval)
+        for w in workers:
+            w.kill()
+        states = ", ".join(
+            f"worker {w.index}: {'killed' if c is None else c}"
+            for w, c in zip(workers, (w.poll() for w in workers))
+        )
+        print(
+            f"pod-launch: worker {failed[0]} failed ({failed[2]}); "
+            f"killed the rest of the fleet to free the rendezvous [{states}]",
+            file=sys.stderr,
+        )
+        if attempt > restarts:
+            return failed[1]
+        print(
+            f"pod-launch: restarting the whole job "
+            f"(attempt {attempt + 1}/{restarts + 1})",
+            file=sys.stderr,
+        )
+
+
 def run(args) -> int:
     command = assemble_worker_command(args)
-    cmd = build_gcloud_ssh_cmd(args.tpu_name, args.tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha)
+    if args.num_workers is None:
+        if args.restart_on_failure or args.heartbeat_timeout:
+            raise ValueError(
+                "--restart_on_failure/--heartbeat_timeout need --num_workers "
+                "(supervision runs one ssh per worker)"
+            )
+        cmd = build_gcloud_ssh_cmd(
+            args.tpu_name, args.tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha
+        )
+        if args.debug:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            return 0
+        return subprocess.run(cmd).returncode
+
+    if args.worker != "all":
+        raise ValueError(
+            "--worker targets a single host and conflicts with --num_workers "
+            "supervision (which spawns one ssh per worker 0..N-1); drop one"
+        )
+
+    def spawn(i: int):
+        cmd = build_gcloud_ssh_cmd(
+            args.tpu_name, args.tpu_zone, command, worker=str(i), use_alpha=args.use_alpha
+        )
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+        )
+
     if args.debug:
-        print(" ".join(shlex.quote(c) for c in cmd))
+        for i in range(args.num_workers):
+            cmd = build_gcloud_ssh_cmd(
+                args.tpu_name, args.tpu_zone, command, worker=str(i), use_alpha=args.use_alpha
+            )
+            print(" ".join(shlex.quote(c) for c in cmd))
         return 0
-    return subprocess.run(cmd).returncode
+    return supervise(
+        spawn, args.num_workers,
+        restarts=args.restart_on_failure,
+        heartbeat_timeout=args.heartbeat_timeout,
+    )
