@@ -53,7 +53,9 @@ def build_config_interactive() -> dict:
     config["num_processes"] = _ask("How many hosts (processes) will you launch on", 1, int)
     if config["num_processes"] > 1:
         config["coordinator_address"] = _ask("Coordinator address (host:port) for rendezvous", "localhost:8476")
-    config["mixed_precision"] = _ask("Mixed precision (no/fp16/bf16)", "bf16")
+    from .menu import select
+
+    config["mixed_precision"] = select("Mixed precision?", ["no", "fp16", "bf16"], "bf16")
     par = config["parallelism"]
     par["fsdp"] = _ask("FSDP (parameter-sharding) axis size", 1, int)
     par["tensor"] = _ask("Tensor-parallel axis size", 1, int)

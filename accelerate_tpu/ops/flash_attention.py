@@ -430,7 +430,12 @@ def _bwd_dkv_kernel(
 
 
 def _flash_backward(res, g, cfg: _Cfg, dlse=None):
-    q, k, v, mask, limit, offsets, bias, out, lse = res
+    q, k, v, mask, limit, offsets, bias, out, lse3 = res
+    # saved residuals hold lse UNPADDED [B, N, S]: the kernels' 8-sublane
+    # layout pads its minor dim to 128 lanes on HBM (16x — 2.25 GB at
+    # bs32/seq1024/12 layers when saved across the fwd/bwd boundary under
+    # the save_flash remat policy). Rebroadcast only for the kernel call.
+    lse = jnp.broadcast_to(lse3[..., None], (*lse3.shape, 8))
     b, n, sq, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
@@ -579,8 +584,8 @@ def _fwd_rule(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
     from jax.ad_checkpoint import checkpoint_name
 
     out = checkpoint_name(out, "flash_out")
-    lse = checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, mask, limit, offsets, bias, out, lse)
+    lse3 = checkpoint_name(lse[..., 0], "flash_lse")
+    return out, (q, k, v, mask, limit, offsets, bias, out, lse3)
 
 
 def _bwd_rule(cfg: _Cfg, res, g):
@@ -607,7 +612,7 @@ def _flash_attention_lse_bnsd(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
 
 def _lse_fwd_rule(q, k, v, mask, limit, offsets, bias, cfg: _Cfg):
     out, lse = _flash_forward(q, k, v, mask, limit, offsets, bias, cfg)
-    return (out, lse), (q, k, v, mask, limit, offsets, bias, out, lse)
+    return (out, lse), (q, k, v, mask, limit, offsets, bias, out, lse[..., 0])
 
 
 def _lse_bwd_rule(cfg: _Cfg, res, gs):

@@ -1,0 +1,133 @@
+"""Bullet menu (reference commands/menu/ analogue): TTY arrow navigation via
+a pty, and the numbered non-TTY fallback the reference lacks."""
+
+import os
+import pty
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands.menu import BulletMenu, select
+
+
+def test_plain_fallback_default(monkeypatch, capsys):
+    monkeypatch.setattr("sys.stdin", type("S", (), {"isatty": lambda self: False})())
+    monkeypatch.setattr("builtins.input", lambda prompt="": "")
+    assert BulletMenu("pick", ["a", "b", "c"], default=1).run() == 1
+
+
+def test_plain_fallback_by_index_and_name(monkeypatch):
+    monkeypatch.setattr("sys.stdin", type("S", (), {"isatty": lambda self: False})())
+    monkeypatch.setattr("builtins.input", lambda prompt="": "2")
+    assert BulletMenu("pick", ["a", "b", "c"]).run() == 2
+    monkeypatch.setattr("builtins.input", lambda prompt="": "fp16")
+    assert select("precision?", ["no", "fp16", "bf16"], "bf16") == "fp16"
+
+
+def test_plain_fallback_rejects_out_of_range(monkeypatch):
+    monkeypatch.setattr("sys.stdin", type("S", (), {"isatty": lambda self: False})())
+    monkeypatch.setattr("builtins.input", lambda prompt="": "7")
+    with pytest.raises(ValueError, match="out of range"):
+        BulletMenu("pick", ["a", "b"]).run()
+
+
+def _drive_tty(keys: bytes) -> str:
+    """Run the menu on a real pty; send keys only once the menu is DRAWN
+    (the child's interpreter startup runs in canonical mode — bytes written
+    earlier would be cooked, not read by the cbreak loop)."""
+    import select as select_mod
+    import time
+
+    script = (
+        "from accelerate_tpu.commands.menu import BulletMenu;"
+        "print('PICKED', BulletMenu('pick', ['no', 'fp16', 'bf16']).run())"
+    )
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdin=slave, stdout=slave, stderr=subprocess.DEVNULL, close_fds=True,
+    )
+    os.close(slave)
+    out = b""
+    sent = False
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ready, _, _ = select_mod.select([master], [], [], 0.2)
+        if ready:
+            try:
+                chunk = os.read(master, 1024)
+            except OSError:
+                break  # EIO: child exited and released the slave
+            if not chunk:
+                break
+            out += chunk
+        if not sent and b"bf16" in out:  # full menu rendered → cbreak active
+            time.sleep(0.3)  # let the cbreak tcsetattr land
+            os.write(master, keys)
+            sent = True
+        if proc.poll() is not None and not ready:
+            break
+    proc.wait(timeout=10)
+    os.close(master)
+    return out.decode(errors="replace")
+
+
+def test_tty_arrow_navigation():
+    out = _drive_tty(b"\x1b[B\x1b[B\r")  # down, down, enter
+    assert "PICKED 2" in out
+
+
+def test_tty_digit_jump_and_wraparound():
+    out = _drive_tty(b"\x1b[A\r")  # up from 0 wraps to last
+    assert "PICKED 2" in out
+    out = _drive_tty(b"1\r")
+    assert "PICKED 1" in out
+
+
+def test_tty_eof_raises_instead_of_spinning():
+    """A hung-up pty must raise EOFError, not busy-loop in cbreak."""
+    import time
+
+    script = (
+        "from accelerate_tpu.commands.menu import BulletMenu\n"
+        "try:\n"
+        "    BulletMenu('pick', ['a', 'b']).run()\n"
+        "except EOFError:\n"
+        "    print('EOF-OK')\n"
+    )
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdin=slave, stdout=slave, stderr=subprocess.DEVNULL, close_fds=True,
+    )
+    os.close(slave)
+    out = b""
+    deadline = time.monotonic() + 60
+    closed = False
+    import select as select_mod
+
+    while time.monotonic() < deadline:
+        ready, _, _ = select_mod.select([master], [], [], 0.2)
+        if ready:
+            try:
+                chunk = os.read(master, 1024)
+            except OSError:
+                break
+            if not chunk:
+                break
+            out += chunk
+        if not closed and b"b\r\n" in out:  # menu drawn → now hang up stdin
+            time.sleep(0.3)
+            os.write(master, b"\x04")  # cbreak: VEOF delivers a 0-byte read
+            closed = True
+        if proc.poll() is not None and not ready:
+            break
+    proc.wait(timeout=10)
+    os.close(master)
+    assert b"EOF-OK" in out, out
+
+
+def test_ss3_arrows_navigate():
+    out = _drive_tty(b"\x1bOB\r")  # SS3 down
+    assert "PICKED 1" in out
