@@ -84,8 +84,14 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
-) -> np.ndarray:
+    return_device: bool = False,
+) -> "np.ndarray | jax.Array":
     """Greedy (temperature=0) or sampled generation. Returns [B, S+new] ids.
+
+    ``return_device=True`` returns the concatenated ids as a DEVICE array with
+    no host fetch (and no eos truncation, which is host-side) — benchmarks use
+    it so the clock can stop on ``block_until_ready`` instead of paying the
+    transport's fixed device→host fetch latency inside the timed region.
 
     Works for any causal model implementing the decode protocol —
     ``init_cache(batch, max_len, dtype)`` + ``forward_with_cache(params, ids,
@@ -133,6 +139,8 @@ def generate(
         tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     else:
         tokens = first[:, None]
+    if return_device:
+        return jnp.concatenate([input_ids, tokens], axis=1)
     out = np.concatenate([np.asarray(input_ids), np.asarray(tokens)], axis=1)
     if eos_token_id is not None:
         # truncate after first EOS per row (host-side cosmetic)

@@ -543,7 +543,24 @@ def bench_big_model_resident() -> dict:
     """The reference table's GPU-RESIDENT rows (GPT-J-6B fp16: 0.05 s/token,
     BASELINE.md:17): every weight on device, no streaming — the decode loop
     is ONE compiled program (``lax.scan`` over tokens, models/generation.py),
-    so per-token cost is pure on-chip compute + one program dispatch."""
+    so per-token cost is pure on-chip compute + one program dispatch.
+
+    Timed with the same paired-window latency correction as the training
+    benches: a single ``generate`` call pays a FIXED ~120 ms (2 program
+    dispatches + the fence) regardless of token count, so a raw 20-token
+    window reads mostly overhead, not decode — the r01–r04 resident number
+    (8.3 ms/tok) was ~90% this fixed cost (VERDICT r4 weak #4). Timing n and
+    8n tokens and differencing isolates the chip's actual per-token rate
+    (measured r5: ~0.7 ms/tok for llama-125m, i.e. ~⅓ of HBM-bandwidth-bound);
+    the fixed part is reported as ``dispatch_s``.
+
+    Fencing caveat (measured r5): BEFORE the process's first device→host
+    fetch, ``block_until_ready`` returns without waiting on this transport
+    (20 generated tokens "completed" in 2.8 ms); after one fetch it fences
+    correctly. So the section takes one sacrificial fetch up front, then
+    fences every window with a SCALAR fetch — fixed-latency, and differenced
+    away with the dispatches. Safe here because nothing downstream streams
+    H2D (the streamed sections run in their own fetch-free subprocesses)."""
     import jax
     import jax.numpy as jnp
 
@@ -558,15 +575,35 @@ def bench_big_model_resident() -> dict:
     params = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a, jnp.bfloat16)), params)
 
     tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
-    n_new = 20
-    out = generate(model, params, tokens, max_new_tokens=n_new)  # compile (2 programs)
-    start = time.perf_counter()
-    out = generate(model, params, tokens, max_new_tokens=n_new)
-    s_per_token = (time.perf_counter() - start) / n_new
-    assert out.shape == (1, 4 + n_new) and (out >= 0).all(), out
+    out = generate(model, params, tokens, max_new_tokens=4, return_device=True)
+    int(np.asarray(out)[0, -1])  # sacrificial fetch: enter the fenced regime
+
+    def best_time(n_new: int, tries: int = 4):
+        warm = generate(model, params, tokens, max_new_tokens=n_new, return_device=True)
+        int(np.asarray(warm[0, -1]))  # compiles prefill+decode at this length
+        best = float("inf")
+        last = None
+        for _ in range(tries):
+            start = time.perf_counter()
+            out = generate(model, params, tokens, max_new_tokens=n_new, return_device=True)
+            int(np.asarray(out[0, -1]))  # scalar fence
+            best = min(best, time.perf_counter() - start)
+            last = out
+        return best, last
+
+    n = 20
+    t_small, _ = best_time(n)
+    t_big, out = best_time(8 * n)
+    if t_big > t_small:
+        s_per_token = (t_big - t_small) / (7 * n)
+    else:  # noise collapsed the difference: fall back to the raw long window
+        s_per_token = t_big / (8 * n)
+    host = np.asarray(out)  # post-clock fetch: tokens must be real values
+    assert host.shape == (1, 4 + 8 * n) and (host >= 0).all(), host
     return {
         "bigmodel_resident_model": name,
-        "bigmodel_resident_s_per_token": round(s_per_token, 4),
+        "bigmodel_resident_s_per_token": round(s_per_token, 5),
+        "bigmodel_resident_dispatch_s": round(max(t_small - n * s_per_token, 0.0), 3),
     }
 
 
