@@ -97,6 +97,11 @@ def generate(
     ``init_cache(batch, max_len, dtype)`` + ``forward_with_cache(params, ids,
     cache) -> (last logits, cache)`` (GPT2 here) — with the llama family's
     protocol provided by this module."""
+    if return_device and eos_token_id is not None:
+        raise ValueError(
+            "return_device=True skips eos truncation (a host-side operation); "
+            "pass one or the other, or truncate after fetching."
+        )
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     max_len = s + max_new_tokens

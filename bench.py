@@ -18,12 +18,14 @@ other targets ride in the same single JSON line under ``extra``:
 Regression gate: every metric in ``PERF_FLOORS`` is gated — ``regression``
 flips true if any gated metric moves >10% past its recorded floor (direction
 aware: throughput/MFU floors are minimums, latency floors are maximums).
-Every bench section is bracketed by ambient probes (the shared transport
-oscillates on minute scales), and each metric's verdict comes from its LOCAL
-probe pair (``metric_verdicts``): a metric whose section straddled a
-contention dip reads "indeterminate" instead of polluting the gate, and the
+Every bench section is bracketed by latency-corrected chip-compute probes
+(``_ambient_probe``), and each metric's verdict comes from its LOCAL probe
+pair (``metric_verdicts``): a metric whose section straddled genuine chip
+contention reads "indeterminate" instead of polluting the gate, and the
 overall ``regression`` is the string ``"indeterminate"`` only when no clean
-breach exists but some metric lacked a clean window.
+breach exists but some metric lacked a clean window. (Transport-latency
+swings no longer trip this: both the measurements and the probe difference
+the fixed per-sync latency away.)
 
 Prints exactly ONE JSON line.
 """
@@ -108,6 +110,20 @@ def _train_flops_per_step(config, batch: int, seq: int) -> float:
     return dense + attention
 
 
+def _streaming_footprint(lm) -> tuple[int, int, int]:
+    """(resident_bytes, window_bytes, streamed_total_bytes) of a StreamedModel.
+
+    Mirrors the executor's staging exactly — resident components (exact
+    nbytes, whatever dtype they were loaded in), a DOUBLE-buffered group window
+    (big_modeling._iter_device_layer_groups keeps at most two staged groups
+    alive), and the full offloaded stack. If the buffering scheme changes,
+    update here once; every section's memory accounting reads these."""
+    resident = sum(v.nbytes for v in lm.resident.values())
+    window = 2 * lm.group_size * lm._layer_bytes()
+    streamed_total = len(lm.layer_buffers) * lm._layer_bytes()
+    return resident, window, streamed_total
+
+
 def _reset_state():
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
@@ -116,13 +132,21 @@ def _reset_state():
     PartialState._reset_state()
 
 
-def _ambient_matmul_tflops() -> float:
-    """Chip+transport health probe: best-window TFLOP/s of chained 4k bf16
-    matmuls. On a healthy, idle v5e through this transport the probe lands
-    well above 30; heavy co-tenancy or relay congestion drags every
-    benchmark down with it (observed identical-code swings of 20-32
-    steps/sec on the bert metric). Reported so a low benchmark number can be
-    attributed to the environment rather than the code."""
+def _ambient_probe() -> tuple[float, float]:
+    """(chip_tflops, transport_latency_s) — latency-corrected health probe.
+
+    Times chained 4k bf16 matmul windows of 40 and 160 ending in one scalar
+    fetch each (the only reliable fence) and differences the windows: the
+    per-matmul time gives the chip's actual sustained rate, and the fixed
+    remainder is the transport's per-sync latency. The r01–r04 single-window
+    probe conflated the two — 20 matmuls are ~14 ms of compute at spec, so
+    with an ~80-110 ms tunnel sync the probe COULD NOT read above ~22-25
+    TFLOPs on a perfectly healthy chip, and every "ambient degraded /
+    indeterminate" verdict of rounds 3-4 traced to exactly this artifact
+    (calibrated r5: same minute, old probe 27-29 "degraded", corrected probe
+    177-209 TFLOPs — i.e. at spec). The two numbers now gate different
+    things: chip_tflops gates the compute benchmarks (real co-tenancy),
+    transport_latency gates the DMA-bound big-model section."""
     import jax
     import jax.numpy as jnp
 
@@ -133,21 +157,38 @@ def _ambient_matmul_tflops() -> float:
     f = jax.jit(lambda a: (a @ a) / 64.0)
     r = f(x)
     float(r[0, 0])
-    best = float("inf")
-    for _ in range(5):
-        start = time.perf_counter()
-        r = x
-        for _ in range(20):
-            r = f(r)
-        float(r[0, 0])
-        best = min(best, time.perf_counter() - start)
-    return 20 * 2 * 4096**3 / best / 1e12
+
+    def window(n: int, tries: int = 3) -> float:
+        best = float("inf")
+        for _ in range(tries):
+            start = time.perf_counter()
+            r = x
+            for _ in range(n):
+                r = f(r)
+            float(r[0, 0])
+            best = min(best, time.perf_counter() - start)
+        return best
+
+    t_small, t_big = window(40), window(160)
+    per = (t_big - t_small) / 120 if t_big > t_small else t_big / 160
+    latency = max(t_small - 40 * per, 0.0)
+    return 2 * 4096**3 / per / 1e12, latency
 
 
-# observed on this transport: severe contention reads 18-23 (bert metric
-# collapses to 20-26), moderate reads 25-28 (bert ~30, within the gate's 10%
-# band), healthy >30. Below this the verdict is indeterminate.
-AMBIENT_HEALTHY_TFLOPS = 25.0
+# Chip-compute health gate for the CORRECTED probe: an idle v5e reads
+# ~175-210 TFLOPs through any transport weather (calibrated r5), but sync
+# jitter of ±10-20 ms inside the differenced windows spreads single readings
+# well around that (observed 92-806 with 20/80-matmul windows; the 40/160
+# windows above roughly halve the relative noise). The gate sits far below
+# the noise floor of a healthy chip: only genuine co-tenant compute drags a
+# reading under it, making throughput/MFU verdicts the environment's, not
+# the code's → indeterminate.
+AMBIENT_HEALTHY_TFLOPS = 60.0
+# Transport gate for the streamed big-model section: per-sync latency above
+# this marks the tunnel congested enough that a ≥1B bf16 streamed pass risks
+# the driver's command budget (the section's subprocess timeout still bounds
+# the worst case). Observed r5 healthy-chip latencies: 78-107 ms.
+TRANSPORT_LATENCY_MAX_S = 0.15
 
 
 def _best_window_rate(step, batch, n_steps: int = 10, windows: int = 3) -> float:
@@ -345,20 +386,33 @@ def bench_big_model_inference() -> dict:
     stats_before = device.memory_stats() or {}
 
     tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
-    n_new = 10
+    n_new = 4
 
     def timed_generate(lm):
-        # warmup compiles at the SAME max_len as the timed run; return_device
-        # keeps everything fetch-free so this run AND any later timed run
-        # stay in the fast DMA regime (ONE device→host fetch permanently
-        # degrades H2D on tunneled transports). The device output is returned
-        # so the caller can fetch/sanity-check it after ALL clocks stop.
-        warm = lm.generate(tokens, max_new_tokens=n_new, return_device=True)
-        jax.block_until_ready(warm)
-        start = time.perf_counter()
-        out = lm.generate(tokens, max_new_tokens=n_new, return_device=True)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - start) / n_new, out
+        # Paired n / 3n token windows, differenced — return_device keeps the
+        # whole section fetch-free so both runs stay in the fast DMA regime
+        # (ONE device→host fetch permanently degrades H2D on tunneled
+        # transports), and the pairing makes the rate immune to the two fixed
+        # artifacts a single window carries: the per-call overhead AND any
+        # unfenced tail left by ``block_until_ready`` (which is not a
+        # reliable fence before a process's first fetch — see
+        # bench_big_model_resident; the streamed host loop is
+        # backpressure-synchronous per group, so the tail is at most one
+        # group's compute, and fixed). The post-clock value fetch below is
+        # timed as ``bigmodel_drain_s``: a drain far above the transport's
+        # fixed latency would expose under-waited clocks.
+        def one(n: int):
+            warm = lm.generate(tokens, max_new_tokens=n, return_device=True)
+            jax.block_until_ready(warm)
+            start = time.perf_counter()
+            out = lm.generate(tokens, max_new_tokens=n, return_device=True)
+            jax.block_until_ready(out)
+            return time.perf_counter() - start, out
+
+        t_small, _ = one(n_new)
+        t_big, out = one(3 * n_new)
+        per = (t_big - t_small) / (2 * n_new) if t_big > t_small else t_big / (3 * n_new)
+        return per, out
 
     with tempfile.TemporaryDirectory() as d:
         save_model_weights(params, d, max_shard_size="512MB")
@@ -391,10 +445,18 @@ def bench_big_model_inference() -> dict:
         int8_s_per_token, out_int8 = timed_generate(lm8)
         stats_after8 = device.memory_stats() or {}
 
-    # post-clock fetches: the generated tokens must be real values
-    for out in (out_bf16, out_int8):
-        host = np.asarray(out)
-        assert host.shape == (1, 4 + n_new) and (host >= 0).all(), host
+    # ONE post-clock value fetch (int8 — the full quantized path end to end),
+    # timed as the queue-drain evidence for the fenceless windows above. The
+    # observed drain (measured r5: ~575 s for a 64-byte fetch) far exceeds
+    # any possible pending work (~9 GB of H2D at the transport's own rate fit
+    # inside the timed windows, so backpressure proves the streaming really
+    # happened in-window) — it is the transport's D2H-after-bulk-H2D
+    # pathology, which is also why the bf16 output gets shape-checked only.
+    drain_start = time.perf_counter()
+    host = np.asarray(out_int8)
+    assert host.shape == (1, 4 + 3 * n_new) and (host >= 0).all(), host
+    assert out_bf16.shape == (1, 4 + 3 * n_new) and out_bf16.dtype == jnp.int32
+    drain_s = time.perf_counter() - drain_start
 
     result = {
         "bigmodel_model": name,
@@ -402,21 +464,29 @@ def bench_big_model_inference() -> dict:
         "bigmodel_s_per_token": round(s_per_token, 4),
         "bigmodel_int8_s_per_token": round(int8_s_per_token, 4),
         "bigmodel_int8_ratio": round(int8_s_per_token / s_per_token, 3),
+        "bigmodel_drain_s": round(drain_s, 2),
     }
+    resident, window, streamed_total = _streaming_footprint(lm)
     if "peak_bytes_in_use" in stats_after:
         # invariant: HBM never held the whole offloaded stack — bound peak by
         # resident components + the double-buffered streaming window
-        resident = sum(int(np.prod(v.shape)) * 2 for v in lm.resident.values())
-        window = 2 * lm.group_size * lm._layer_bytes()
         budget = stats_before.get("peak_bytes_in_use", 0) + resident + window + (64 << 20)
         result["bigmodel_peak_bytes"] = int(stats_after["peak_bytes_in_use"])
         result["bigmodel_memory_ok"] = bool(stats_after["peak_bytes_in_use"] <= budget)
         # second snapshot after the quantized run: lm and lm8 residents and
         # both streaming windows may briefly co-exist
-        budget8 = budget + resident + 2 * lm8.group_size * lm8._layer_bytes() + (64 << 20)
+        budget8 = budget + resident + _streaming_footprint(lm8)[1] + (64 << 20)
         result["bigmodel_int8_memory_ok"] = bool(
             stats_after8.get("peak_bytes_in_use", 0) <= budget8
         )
+    else:
+        # no memory_stats on tunneled transports — report the structural
+        # bound (see bench_big_model_large_inner for rationale; enforced by
+        # tests/test_big_modeling.py::test_streamed_forward_device_footprint_bounded)
+        result["bigmodel_hbm_bound_gb"] = round((resident + window) / 2**30, 2)
+        result["bigmodel_memory_ok"] = bool(window < streamed_total)
+        resident8, window8, streamed_total8 = _streaming_footprint(lm8)
+        result["bigmodel_int8_memory_ok"] = bool(window8 < streamed_total8)
     return result
 
 
@@ -428,22 +498,22 @@ def bench_big_model_large() -> dict:
     invariant at a scale where the full model genuinely cannot sit wholly
     in the streaming window.
 
-    The section pre-checks transport health via the ambient MATMUL probe
-    (compute and DMA degrade together on this shared transport, and a D2H
-    fetch cannot poison the fetch-free child the way a direct bandwidth
-    probe would) and skips below the calibrated gate: at the degraded
-    transport's ~6 MB/s a single bf16 pass of a 1B model would take >6
-    minutes and blow the driver's command budget.
+    The section pre-checks transport health via the probe's per-sync LATENCY
+    (the tunnel's congestion signal — a D2H bandwidth probe would poison the
+    fetch-free child's fast DMA regime, so latency is the usable proxy) and
+    skips above the gate: at the degraded transport's ~6 MB/s a single bf16
+    pass of a 1B model would take >6 minutes and blow the driver's command
+    budget.
     """
     import jax
 
     _reset_state()
 
     if jax.devices()[0].platform == "tpu":  # the gate is calibrated for TPU
-        ambient = _ambient_matmul_tflops()
-        if ambient < AMBIENT_HEALTHY_TFLOPS:
+        _, latency = _ambient_probe()
+        if latency > TRANSPORT_LATENCY_MAX_S:
             return {
-                "bigmodel_large_skipped": f"ambient {ambient:.1f} TFLOPs < {AMBIENT_HEALTHY_TFLOPS}",
+                "bigmodel_large_skipped": f"transport latency {latency * 1000:.0f}ms > {TRANSPORT_LATENCY_MAX_S * 1000:.0f}ms",
             }
     # the probe fetched device values: THIS process is in the slow-DMA regime
     # on tunneled transports — the real measurement runs in a fetch-free child
@@ -462,6 +532,8 @@ def bench_big_model_large() -> dict:
 
 
 def bench_big_model_large_inner() -> dict:
+    import sys
+
     import jax
     import jax.numpy as jnp
 
@@ -469,12 +541,20 @@ def bench_big_model_large_inner() -> dict:
     from accelerate_tpu.models import Llama
     from accelerate_tpu.models.config import param_count
 
+    t0 = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        # stderr stage log: stdout stays the single JSON line; the parent
+        # surfaces stderr on failure, so a timeout names the slow stage
+        print(f"[bigmodel_large +{time.perf_counter() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
     name = os.environ.get("BENCH_BIGMODEL_LARGE", "llama-1b")
     model = Llama(name)
     n_params = param_count(model.config)
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         params = jax.device_get(jax.jit(model._init)(jax.random.key(0)))
     params = jax.tree.map(lambda a: np.asarray(a, np.dtype(jnp.bfloat16)), params)
+    _stage(f"host init done ({n_params / 1e9:.2f}B params)")
 
     device = jax.devices()[0]
     stats_before = device.memory_stats() or {}
@@ -492,6 +572,7 @@ def bench_big_model_large_inner() -> dict:
     with tempfile.TemporaryDirectory() as d:
         save_model_weights(params, d, max_shard_size="2GB")
         del params
+        _stage("checkpoint written")
         from accelerate_tpu import load_checkpoint_and_dispatch
         from accelerate_tpu.big_modeling import load_and_quantize_model
         from accelerate_tpu.utils.quantization import QuantizationConfig
@@ -505,8 +586,10 @@ def bench_big_model_large_inner() -> dict:
             stream_window_bytes=DEFAULT_WINDOW_LARGE,
         )
         load_s = time.perf_counter() - start
+        _stage("bf16 load+dispatch done")
         s_per_token, out_bf16 = timed_generate(lm)
         stats_after = device.memory_stats() or {}
+        _stage("bf16 streamed decode done")
 
         lm.evict()  # free the resident HBM before the quantized pass
         lm4 = load_and_quantize_model(
@@ -514,11 +597,18 @@ def bench_big_model_large_inner() -> dict:
             device_map=device_map, dtype=jnp.bfloat16,
             stream_window_bytes=DEFAULT_WINDOW_LARGE,
         )
+        _stage("int4 quantize+pack done")
         int4_s_per_token, out_int4 = timed_generate(lm4)
+        _stage("int4 streamed decode done")
 
+    # Shape-only validation — deliberately NO value fetch: after ~20 GB of
+    # streamed H2D, a D2H fetch of even 32 bytes takes >10 minutes on this
+    # tunneled transport (measured r5; it is what actually blew the r5 first
+    # run's 1400 s subprocess budget, not the streaming). Token values are
+    # argmax outputs, in-range by construction; the 125M section (which
+    # streams 60x less) keeps its value assertions.
     for out in (out_bf16, out_int4):
-        host = np.asarray(out)
-        assert host.shape == (1, 4 + n_new) and (host >= 0).all(), host
+        assert out.shape == (1, 4 + n_new) and out.dtype == jnp.int32, out
 
     result = {
         "bigmodel_large_model": name,
@@ -527,12 +617,23 @@ def bench_big_model_large_inner() -> dict:
         "bigmodel_large_s_per_token": round(s_per_token, 4),
         "bigmodel_large_int4_s_per_token": round(int4_s_per_token, 4),
     }
+    resident, window, streamed_total = _streaming_footprint(lm)
     if "peak_bytes_in_use" in stats_after:
-        resident = sum(int(np.prod(v.shape)) * 2 for v in lm.resident.values())
-        window = 2 * lm.group_size * lm._layer_bytes()
         budget = stats_before.get("peak_bytes_in_use", 0) + resident + window + (64 << 20)
         result["bigmodel_large_peak_gb"] = round(stats_after["peak_bytes_in_use"] / 2**30, 2)
         result["bigmodel_large_memory_ok"] = bool(stats_after["peak_bytes_in_use"] <= budget)
+    else:
+        # tunneled transports expose no memory_stats (device.memory_stats()
+        # is None via axon): report the STRUCTURAL bound instead. The
+        # executor holds resident + a double-buffered group window by
+        # construction — enforced with jax.live_arrays() at every group
+        # boundary in tests/test_big_modeling.py::
+        # test_streamed_forward_device_footprint_bounded — so "ok" here
+        # means the offloaded stack genuinely exceeds the on-device window
+        # (the run streamed; nothing could have cheated residency).
+        result["bigmodel_large_hbm_bound_gb"] = round((resident + window) / 2**30, 2)
+        result["bigmodel_large_streamed_gb"] = round(streamed_total / 2**30, 2)
+        result["bigmodel_large_memory_ok"] = bool(window < streamed_total)
     return result
 
 
@@ -556,7 +657,9 @@ def bench_big_model_resident() -> dict:
 
     Fencing caveat (measured r5): BEFORE the process's first device→host
     fetch, ``block_until_ready`` returns without waiting on this transport
-    (20 generated tokens "completed" in 2.8 ms); after one fetch it fences
+    (20 generated tokens "completed" in 2.8 ms; the streamed sections are
+    immune — their host loop is backpressure-synchronous and their paired
+    windows difference any fixed tail away); after one fetch it fences
     correctly. So the section takes one sacrificial fetch up front, then
     fences every window with a SCALAR fetch — fixed-latency, and differenced
     away with the dispatches. Safe here because nothing downstream streams
@@ -594,17 +697,20 @@ def bench_big_model_resident() -> dict:
     n = 20
     t_small, _ = best_time(n)
     t_big, out = best_time(8 * n)
-    if t_big > t_small:
+    paired = t_big > t_small
+    if paired:
         s_per_token = (t_big - t_small) / (7 * n)
     else:  # noise collapsed the difference: fall back to the raw long window
         s_per_token = t_big / (8 * n)
     host = np.asarray(out)  # post-clock fetch: tokens must be real values
     assert host.shape == (1, 4 + 8 * n) and (host >= 0).all(), host
-    return {
+    result = {
         "bigmodel_resident_model": name,
         "bigmodel_resident_s_per_token": round(s_per_token, 5),
-        "bigmodel_resident_dispatch_s": round(max(t_small - n * s_per_token, 0.0), 3),
     }
+    if paired:  # only the differenced pair isolates the fixed per-call cost
+        result["bigmodel_resident_dispatch_s"] = round(max(t_small - n * s_per_token, 0.0), 3)
+    return result
 
 
 def _bench_subprocess(which: str) -> dict:
@@ -657,11 +763,16 @@ def main() -> None:
     extra: dict = {}
     errors: dict = {}
     probes: list[float] = []
+    latencies: list[float] = []
     section_health: dict[str, tuple[float, float]] = {}
 
     def _probe() -> float:
-        value = _ambient_matmul_tflops() if on_tpu else float("inf")
-        probes.append(round(value, 1) if on_tpu else -1.0)
+        if not on_tpu:
+            probes.append(-1.0)
+            return float("inf")
+        value, latency = _ambient_probe()
+        probes.append(round(value, 1))
+        latencies.append(round(latency, 3))
         return value
 
     sections = [
@@ -751,6 +862,7 @@ def main() -> None:
         kind = getattr(device0, "device_kind", "").lower()
         floors = next((f for key, f in PERF_FLOORS.items() if key in kind), None)
         payload["ambient_matmul_tflops"] = probes
+        payload["transport_latency_s"] = latencies
         if floors is not None:
             payload["floor"] = floors["bert_train_steps_per_sec_per_chip"][0]
             payload["floors"] = {m: f for m, (f, _) in floors.items()}
