@@ -116,9 +116,19 @@ def _streaming_footprint(lm) -> tuple[int, int, int]:
     Mirrors the executor's staging exactly — resident components (exact
     nbytes, whatever dtype they were loaded in), a DOUBLE-buffered group window
     (big_modeling._iter_device_layer_groups keeps at most two staged groups
-    alive), and the full offloaded stack. If the buffering scheme changes,
-    update here once; every section's memory accounting reads these."""
-    resident = sum(v.nbytes for v in lm.resident.values())
+    alive), and the full offloaded stack. Layers a device_map pins to
+    "device" count as resident (they sit in HBM for the model's lifetime),
+    not streamed. If the buffering scheme changes, update here once; every
+    section's memory accounting reads these."""
+
+    def _nbytes(buf) -> int:
+        return sum(p.nbytes for p in buf) if isinstance(buf, tuple) else buf.nbytes
+
+    resident = sum(v.nbytes for v in lm.resident.values()) + sum(
+        _nbytes(lm.layer_buffers[i])
+        for i in range(len(lm.layer_buffers))
+        if lm.layer_on_device[i]
+    )
     window = 2 * lm.group_size * lm._layer_bytes()
     streamed_total = sum(
         lm._layer_bytes() for i in range(len(lm.layer_buffers)) if not lm.layer_on_device[i]
@@ -525,23 +535,7 @@ def bench_big_model_large() -> dict:
             }
     # the probe fetched device values: THIS process is in the slow-DMA regime
     # on tunneled transports — the real measurement runs in a fetch-free child
-    import subprocess
-    import sys
-
-    env = dict(os.environ)
-    env["BENCH_ONLY"] = "bigmodel_large_inner"
-    try:
-        result = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=1400, env=env,
-        )
-    except subprocess.TimeoutExpired as e:
-        stderr = e.stderr.decode(errors="replace") if isinstance(e.stderr, bytes) else (e.stderr or "")
-        # keep the child's stage log: it names the stage that blew the budget
-        raise RuntimeError(f"bigmodel_large timed out after {e.timeout:.0f}s:\n{stderr}") from None
-    if result.returncode != 0:
-        raise RuntimeError(f"bigmodel_large failed:\n{result.stdout}\n{result.stderr}")
-    return json.loads(result.stdout.strip().splitlines()[-1])
+    return _bench_subprocess("bigmodel_large_inner", timeout=1400)
 
 
 def bench_big_model_large_inner() -> dict:
@@ -561,7 +555,7 @@ def bench_big_model_large_inner() -> dict:
         # surfaces stderr on failure, so a timeout names the slow stage
         print(f"[bigmodel_large +{time.perf_counter() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
-    name = os.environ.get("BENCH_BIGMODEL_LARGE", "llama-1b")
+    name = os.environ.get("BENCH_BIGMODEL_LARGE", DEFAULT_LARGE_MODEL)
     model = Llama(name)
     n_params = param_count(model.config)
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
@@ -652,13 +646,22 @@ def bench_big_model_large_inner() -> dict:
 
 
 DEFAULT_WINDOW_LARGE = 512 << 20  # the big-model default window
+# One default for BOTH large rows (streamed + resident): they exist as a
+# pair — same model streamed from host RAM vs fully HBM-resident — and
+# benchmarking different models would invalidate the comparison.
+DEFAULT_LARGE_MODEL = "llama-1b"
 
 
-def bench_big_model_resident() -> dict:
+def bench_big_model_resident(
+    name: "str | None" = None, prefix: str = "bigmodel_resident"
+) -> dict:
     """The reference table's GPU-RESIDENT rows (GPT-J-6B fp16: 0.05 s/token,
     BASELINE.md:17): every weight on device, no streaming — the decode loop
     is ONE compiled program (``lax.scan`` over tokens, models/generation.py),
-    so per-token cost is pure on-chip compute + one program dispatch.
+    so per-token cost is pure on-chip compute + one program dispatch. Run
+    once for llama-125m and once for the ≥1B model (2.5 GB bf16 resident in
+    the v5e's 16 GB HBM — the direct comparable to the reference's GPT-J-6B
+    fp16 resident row).
 
     Timed with the same paired-window latency correction as the training
     benches: a single ``generate`` call pays a FIXED ~120 ms (2 program
@@ -685,10 +688,12 @@ def bench_big_model_resident() -> dict:
     from accelerate_tpu.models.generation import generate
 
     _reset_state()
-    name = os.environ.get("BENCH_BIGMODEL", "llama-125m")
+    name = name or os.environ.get("BENCH_BIGMODEL", "llama-125m")
     model = Llama(name)
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         params = jax.device_get(jax.jit(model._init)(jax.random.key(0)))
+    # H2D of the whole model happens BEFORE the sacrificial fetch below, so
+    # the transfer rides the fast DMA regime even for the multi-GB model
     params = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a, jnp.bfloat16)), params)
 
     tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
@@ -719,15 +724,15 @@ def bench_big_model_resident() -> dict:
     host = np.asarray(out)  # post-clock fetch: tokens must be real values
     assert host.shape == (1, 4 + 8 * n) and (host >= 0).all(), host
     result = {
-        "bigmodel_resident_model": name,
-        "bigmodel_resident_s_per_token": round(s_per_token, 5),
+        f"{prefix}_model": name,
+        f"{prefix}_s_per_token": round(s_per_token, 5),
     }
     if paired:  # only the differenced pair isolates the fixed per-call cost
-        result["bigmodel_resident_dispatch_s"] = round(max(t_small - n * s_per_token, 0.0), 3)
+        result[f"{prefix}_dispatch_s"] = round(max(t_small - n * s_per_token, 0.0), 3)
     return result
 
 
-def _bench_subprocess(which: str) -> dict:
+def _bench_subprocess(which: str, timeout: float = 1500) -> dict:
     """Run a big-model bench section in a FRESH process: the training benches
     fetch losses to the host, and on tunneled TPU transports the first
     device→host fetch permanently degrades H2D DMA ~100x — which is exactly
@@ -743,7 +748,7 @@ def _bench_subprocess(which: str) -> dict:
     try:
         result = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=1500, env=env,
+            capture_output=True, text=True, timeout=timeout, env=env,
         )
     except subprocess.TimeoutExpired as e:
         # surface the child's stderr stage log — it names the slow stage,
@@ -768,6 +773,11 @@ def main() -> None:
         return
     if os.environ.get("BENCH_ONLY") == "bigmodel_resident":
         print(json.dumps(bench_big_model_resident()))
+        return
+    if os.environ.get("BENCH_ONLY") == "bigmodel_large_resident":
+        print(json.dumps(bench_big_model_resident(
+            os.environ.get("BENCH_BIGMODEL_LARGE", DEFAULT_LARGE_MODEL), "bigmodel_large_resident"
+        )))
         return
     if os.environ.get("BENCH_ONLY") == "bigmodel_large":
         print(json.dumps(bench_big_model_large()))
@@ -807,6 +817,7 @@ def main() -> None:
         ("bigmodel", lambda: _bench_subprocess("bigmodel"), ("bigmodel_int8_ratio",)),
         ("bigmodel_large", lambda: _bench_subprocess("bigmodel_large"), ()),
         ("bigmodel_resident", lambda: _bench_subprocess("bigmodel_resident"), ()),
+        ("bigmodel_large_resident", lambda: _bench_subprocess("bigmodel_large_resident"), ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport
