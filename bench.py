@@ -72,6 +72,13 @@ _V5E_FLOORS = {
     # per-token floors do not (both numerator and denominator ride the same
     # DMA regime within one run).
     "bigmodel_int8_ratio": (0.70, "max"),
+    # Resident-decode latency ceilings (r5 observed: 125m 0.21-0.50 ms/tok,
+    # 1b 3.2-3.5 ms/tok ≈ 95% of HBM-bandwidth-bound). Loose maxima — the
+    # paired-window measurement still carries ~2x jitter — that would catch
+    # a decode-loop regression (e.g. the scan falling back to per-token
+    # dispatch) while riding out transport weather.
+    "bigmodel_resident_s_per_token": (0.0010, "max"),
+    "bigmodel_large_resident_s_per_token": (0.0045, "max"),
 }
 PERF_FLOORS = {"v5e": _V5E_FLOORS, "v5 lite": _V5E_FLOORS, "v5litepod": _V5E_FLOORS}
 
@@ -336,10 +343,14 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
     accelerator.prepare_optimizer(optax.adamw(3e-4))
 
     def loss_fn(params, batch):
-        logits = model.apply(params, batch["input_ids"]).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        # logsumexp-form cross-entropy: never materializes the [B,S,V] fp32
+        # log-prob tensor (log_softmax writes+reads ~6.5 GB at bs32/seq1024/
+        # 50k vocab); measured +2% MFU at this shape (r5: 0.374 → 0.381)
+        logits = model.apply(params, batch["input_ids"])[:, :-1].astype(jnp.float32)
         tgt = batch["input_ids"][:, 1:]
-        return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (lse - tgt_logit).mean()
 
     step = accelerator.compiled_step(loss_fn)
     rng = np.random.default_rng(0)
@@ -729,6 +740,13 @@ def bench_big_model_resident(
     }
     if paired:  # only the differenced pair isolates the fixed per-call cost
         result[f"{prefix}_dispatch_s"] = round(max(t_small - n * s_per_token, 0.0), 3)
+    else:
+        # the raw-window fallback still contains the fixed per-window sync
+        # (~0.7 ms/tok at n=20 for the 125m row) that the gating ceiling was
+        # calibrated WITHOUT — flag it so the verdict logic reads the metric
+        # as indeterminate instead of a spurious breach, and the section
+        # retry loop treats the attempt as unclean
+        result[f"{prefix}_s_per_token_unpaired"] = True
     return result
 
 
@@ -816,8 +834,10 @@ def main() -> None:
         ("llama_seq4096", bench_llama_longseq, ("llama_seq4096_train_mfu",)),
         ("bigmodel", lambda: _bench_subprocess("bigmodel"), ("bigmodel_int8_ratio",)),
         ("bigmodel_large", lambda: _bench_subprocess("bigmodel_large"), ()),
-        ("bigmodel_resident", lambda: _bench_subprocess("bigmodel_resident"), ()),
-        ("bigmodel_large_resident", lambda: _bench_subprocess("bigmodel_large_resident"), ()),
+        ("bigmodel_resident", lambda: _bench_subprocess("bigmodel_resident"),
+         ("bigmodel_resident_s_per_token",)),
+        ("bigmodel_large_resident", lambda: _bench_subprocess("bigmodel_large_resident"),
+         ("bigmodel_large_resident_s_per_token",)),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport
@@ -873,7 +893,8 @@ def main() -> None:
                     or (healthy == was_healthy and _better(primary, result.get(primary), best.get(primary)))
                 ):
                     best, best_health = result, (before, after)
-            if healthy and result is not None:
+            unpaired = bool(result and primary and result.get(f"{primary}_unpaired"))
+            if healthy and result is not None and not unpaired:
                 break  # clean window: verdict is determinate, stop burning time
         if best is not None:
             extra.update(best)
@@ -913,7 +934,10 @@ def main() -> None:
                 if got is None:
                     verdicts[metric] = "missing"
                     breaches[metric] = "missing"
-                elif not healthy:
+                elif not healthy or extra.get(f"{metric}_unpaired"):
+                    # contended window, OR a value from the raw-window
+                    # fallback — measured under different methodology than
+                    # the ceiling (it retains the fixed per-window sync)
                     verdicts[metric] = "indeterminate"
                 elif (direction == "min" and got < 0.9 * floor) or (
                     direction == "max" and got > 1.1 * floor
