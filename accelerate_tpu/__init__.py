@@ -20,6 +20,12 @@ from .big_modeling import (
     load_checkpoint_and_dispatch,
 )
 from .data_loader import prepare_data_loader, skip_first_batches
+from .fault_tolerance import (
+    CheckpointManager,
+    ResumePoint,
+    latest_valid_checkpoint,
+    verify_checkpoint,
+)
 from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer

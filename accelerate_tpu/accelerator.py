@@ -45,6 +45,7 @@ from .ops import operations as ops
 from .parallel.sharding import PartitionRules, infer_shardings, replicated, shard_tree
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
+from .state import distributed_is_initialized as _distributed_is_initialized
 from .utils.dataclasses import (
     CompilationConfig,
     FP8RecipeKwargs,
@@ -189,7 +190,7 @@ class Accelerator:
                     getattr(handler, f, None) is not None
                     for f in ("coordinator_address", "num_processes", "process_id")
                 )
-                if jax.distributed.is_initialized() or (
+                if _distributed_is_initialized() or (
                     carries_coordinator and PartialState._shared_state
                 ):
                     raise ValueError(
@@ -1028,14 +1029,34 @@ class Accelerator:
         return _RemovableHandle(self._load_model_hooks, hook)
 
     def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs):
+        """Save model/optimizer/scheduler/scaler/RNG/custom state.
+
+        Atomic by default (``atomic=False`` opts out): staged into
+        ``<output_dir>.tmp`` with a checksummed ``manifest.json`` and renamed
+        into place only once complete, so a kill mid-save never corrupts an
+        existing checkpoint (fault_tolerance.py documents the protocol).
+        """
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, **save_model_kwargs)
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
+        """Restore state saved by ``save_state``. ``input_dir="auto"`` loads
+        the newest checkpoint under the project's checkpoints dir whose
+        manifest VALIDATES — torn or uncommitted dirs are skipped, so a run
+        killed mid-save auto-resumes from the last complete state."""
         from .checkpointing import load_accelerator_state
 
         return load_accelerator_state(self, input_dir, **load_model_kwargs)
+
+    def checkpoint_manager(self, checkpoint_dir: Optional[str] = None, **manager_kwargs):
+        """A ``fault_tolerance.CheckpointManager`` bound to this accelerator:
+        periodic atomic saves + rotation, SIGTERM-boundary saves inside the
+        spot-VM grace window, and ``resume("auto")`` with exact dataloader
+        rewind. See docs/fault_tolerance.md for the canonical loop."""
+        from .fault_tolerance import CheckpointManager
+
+        return CheckpointManager(self, checkpoint_dir=checkpoint_dir, **manager_kwargs)
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)

@@ -344,15 +344,13 @@ def load_model_weights(path: str) -> dict[str, np.ndarray]:
 
 
 def _resolve_save_dir(accelerator, output_dir: Optional[str]) -> str:
+    # Rotation deliberately does NOT happen here: old checkpoints are deleted
+    # only after the new one is committed (save_accelerator_state), so a kill
+    # mid-save can never have destroyed the previous good checkpoint.
     project = accelerator.project_configuration
     if project.automatic_checkpoint_naming:
         base = os.path.join(project.project_dir or output_dir or ".", "checkpoints")
         os.makedirs(base, exist_ok=True)
-        existing = _list_checkpoints(base)
-        if project.total_limit is not None and len(existing) + 1 > project.total_limit:
-            for stale in existing[: len(existing) + 1 - project.total_limit]:
-                logger.info(f"Deleting {stale} to respect total_limit={project.total_limit}")
-                shutil.rmtree(stale, ignore_errors=True)
         target = os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{project.iteration}")
         if os.path.exists(target):
             raise ValueError(f"Checkpoint directory {target} already exists — bump project_configuration.iteration.")
@@ -363,12 +361,9 @@ def _resolve_save_dir(accelerator, output_dir: Optional[str]) -> str:
 
 
 def _list_checkpoints(base: str) -> list[str]:
-    entries = []
-    for name in os.listdir(base):
-        match = re.fullmatch(rf"{CHECKPOINT_DIR_PREFIX}_(\d+)", name)
-        if match:
-            entries.append((int(match.group(1)), os.path.join(base, name)))
-    return [path for _, path in sorted(entries)]
+    from .fault_tolerance import list_checkpoints
+
+    return list_checkpoints(base)
 
 
 def _remove_stale_format(output_dir: str, sharded: bool, num_models: int, num_optimizers: int) -> None:
@@ -396,12 +391,41 @@ def _remove_stale_format(output_dir: str, sharded: bool, num_models: int, num_op
 
 
 def save_accelerator_state(
-    accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True, sharded: bool = False
+    accelerator,
+    output_dir: Optional[str] = None,
+    safe_serialization: bool = True,
+    sharded: bool = False,
+    atomic: bool = True,
+    manifest_metadata: Optional[dict] = None,
 ) -> str:
+    """Save the full accelerator state, atomically by default.
+
+    ``atomic=True`` (the production path) stages every file into
+    ``<output_dir>.tmp``, writes a ``manifest.json`` (per-file sizes +
+    checksums + step/topology metadata), barriers all hosts, and only then
+    renames the staging dir into place — a kill at any instant leaves either
+    the complete previous checkpoint or the complete new one, never a torn
+    directory (fault_tolerance.py documents the protocol). Rotation under
+    ``automatic_checkpoint_naming`` + ``total_limit`` runs strictly after the
+    commit. ``manifest_metadata`` (step/epoch/dataloader positions — what
+    ``CheckpointManager`` passes) rides inside the manifest for auto-resume.
+    """
+    from . import fault_tolerance as _ft
+
     state = PartialState()
-    output_dir = _resolve_save_dir(accelerator, output_dir)
+    final_dir = _resolve_save_dir(accelerator, output_dir)
+    output_dir = _ft.staging_dir_for(final_dir) if atomic else final_dir
+    if atomic and state.is_main_process:
+        if accelerator.project_configuration.automatic_checkpoint_naming:
+            # each save targets a NEW checkpoint_<n>, so a torn staging dir
+            # from a killed previous save would otherwise linger forever
+            _ft.garbage_collect_torn(os.path.dirname(final_dir))
+        elif os.path.exists(output_dir):
+            # torn staging dir from a previous kill of THIS target: GC before reuse
+            shutil.rmtree(output_dir, ignore_errors=True)
+    state.wait_for_everyone()
     os.makedirs(output_dir, exist_ok=True)
-    logger.info(f"Saving current state to {output_dir}")
+    logger.info(f"Saving current state to {final_dir}" + (" (staged atomically)" if atomic else ""))
 
     for hook in accelerator._save_model_hooks:
         hook(accelerator._models, [], output_dir)
@@ -458,15 +482,71 @@ def save_accelerator_state(
     with open(os.path.join(output_dir, RNG_FILE.format(p=state.process_index)), "wb") as f:
         pickle.dump(rng_state(), f)
     state.wait_for_everyone()
-    if accelerator.project_configuration.automatic_checkpoint_naming:
-        accelerator.project_configuration.iteration += 1
-    return output_dir
+
+    if atomic:
+        # -- commit point: manifest, barrier, rename (fault_tolerance.py) --
+        _ft._run_fault_hook("staged", output_dir)
+        if state.is_main_process:
+            metadata = dict(manifest_metadata or {})
+            metadata["sharded"] = sharded
+            manifest = _ft.build_manifest(output_dir, step=metadata.get("step"), metadata=metadata)
+            _ft.write_manifest(output_dir, manifest)
+            _ft._run_fault_hook("manifest", output_dir)
+            _ft.commit_checkpoint(output_dir, final_dir)
+        state.wait_for_everyone()
+        if (
+            not state.is_main_process
+            and os.path.isdir(output_dir)
+            and not os.path.isdir(final_dir)
+        ):
+            # non-shared filesystem: process 0's rename did not move this
+            # host's local staging dir (it holds this host's RNG file) —
+            # commit the local copy with a bare rename. Never the move-aside
+            # path, and tolerate a failed rename: on a shared FS with stale
+            # metadata caching (gcsfuse) the staging dir can APPEAR to still
+            # exist after main's commit, and touching final_dir here would
+            # destroy the checkpoint main just committed.
+            try:
+                os.rename(output_dir, final_dir)
+            except OSError:
+                pass  # cached view of a shared FS — main's commit already won
+
+    project = accelerator.project_configuration
+    if project.automatic_checkpoint_naming:
+        project.iteration += 1
+        # rotation strictly AFTER the commit: a kill anywhere above leaves
+        # the previous good checkpoint untouched
+        if state.is_main_process and project.total_limit is not None:
+            base = os.path.dirname(final_dir)
+            existing = _list_checkpoints(base)
+            for stale in existing[: max(len(existing) - project.total_limit, 0)]:
+                logger.info(f"Deleting {stale} to respect total_limit={project.total_limit}")
+                shutil.rmtree(stale, ignore_errors=True)
+        state.wait_for_everyone()
+    return final_dir
 
 
-def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None) -> None:  # noqa: ARG001
+def load_accelerator_state(
+    accelerator,
+    input_dir: Optional[str] = None,
+    load_kwargs: Optional[dict] = None,  # noqa: ARG001
+    check_checksums: bool = True,
+) -> None:
     state = PartialState()
     project = accelerator.project_configuration
-    if input_dir is None:
+    if input_dir == "auto":
+        # auto-resume: newest checkpoint whose manifest VALIDATES — torn or
+        # uncommitted (.tmp) dirs are skipped, so a run killed mid-save always
+        # restarts from the last complete state with zero operator input.
+        # check_checksums=False skips the CRC pass (sizes/completeness only)
+        # when a full read-before-load of a huge checkpoint is too expensive.
+        from .fault_tolerance import latest_valid_checkpoint
+
+        base = os.path.join(project.project_dir or ".", "checkpoints")
+        input_dir = latest_valid_checkpoint(base, check_checksums=check_checksums)
+        if input_dir is None:
+            raise FileNotFoundError(f"No valid checkpoint under {base} for resume='auto'")
+    elif input_dir is None:
         if not project.automatic_checkpoint_naming:
             raise ValueError("load_state needs input_dir (or automatic_checkpoint_naming).")
         base = os.path.join(project.project_dir or ".", "checkpoints")

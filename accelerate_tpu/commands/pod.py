@@ -67,6 +67,13 @@ def register_subcommand(subparsers):
         "(0 = disabled; needs --num_workers). Training loops that log "
         "per-step keep this armed cheaply.",
     )
+    parser.add_argument(
+        "--auto_resume", action="store_true",
+        help="On a supervised relaunch, append `--resume auto` to the training "
+        "script args so every worker continues from the newest VALID checkpoint "
+        "(fault_tolerance.CheckpointManager.resume); the first attempt runs the "
+        "script unchanged. Needs --num_workers with --restart_on_failure.",
+    )
     from .launch import argparse_remainder
 
     parser.add_argument("training_script")
@@ -75,10 +82,12 @@ def register_subcommand(subparsers):
     return parser
 
 
-def assemble_worker_command(args) -> str:
+def assemble_worker_command(args, resume: bool = False) -> str:
     """The shell command each pod worker runs: env exports + the ordinary
     per-host launch. Every worker runs the SAME command — process identity
-    comes from the TPU runtime, not from per-worker flags."""
+    comes from the TPU runtime, not from per-worker flags. ``resume=True``
+    (supervised relaunch after a failure) appends ``--resume auto`` so the
+    training script restarts from the newest valid checkpoint."""
     parts: list[str] = []
     if args.workdir:
         parts.append(f"cd {shlex.quote(args.workdir)}")
@@ -102,6 +111,8 @@ def assemble_worker_command(args) -> str:
         launch += ["--num_processes", str(args.num_processes)]
     launch.append(args.training_script)
     launch += list(args.training_script_args)
+    if resume:
+        launch += ["--resume", "auto"]
     parts.append(" ".join(shlex.quote(p) for p in launch))
     return "; ".join(parts)
 
@@ -161,11 +172,25 @@ def supervise(
     host) and, with ``restarts`` left, the whole fleet relaunches. Per-worker
     exit codes are reported; the job's exit code is the first failing
     worker's (124 for a heartbeat kill).
+
+    ``spawn`` may accept a second ``attempt`` argument (1-based): relaunch
+    attempts then get a different command — the auto-resume path appends
+    ``--resume auto`` from attempt 2 on, so a restarted fleet continues from
+    the newest valid checkpoint instead of step 0.
     """
+    import inspect
+
+    try:
+        spawn_takes_attempt = len(inspect.signature(spawn).parameters) >= 2
+    except (TypeError, ValueError):
+        spawn_takes_attempt = False
     attempt = 0
     while True:
         attempt += 1
-        workers = [_Worker(i, spawn(i)) for i in range(num_workers)]
+        workers = [
+            _Worker(i, spawn(i, attempt) if spawn_takes_attempt else spawn(i))
+            for i in range(num_workers)
+        ]
         failed = None  # (index, returncode, reason)
         while failed is None:
             codes = [w.poll() for w in workers]
@@ -204,12 +229,13 @@ def supervise(
 
 
 def run(args) -> int:
+    auto_resume = getattr(args, "auto_resume", False)
     command = assemble_worker_command(args)
     if args.num_workers is None:
-        if args.restart_on_failure or args.heartbeat_timeout:
+        if args.restart_on_failure or args.heartbeat_timeout or auto_resume:
             raise ValueError(
-                "--restart_on_failure/--heartbeat_timeout need --num_workers "
-                "(supervision runs one ssh per worker)"
+                "--restart_on_failure/--heartbeat_timeout/--auto_resume need "
+                "--num_workers (supervision runs one ssh per worker)"
             )
         cmd = build_gcloud_ssh_cmd(
             args.tpu_name, args.tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha
@@ -224,10 +250,24 @@ def run(args) -> int:
             "--worker targets a single host and conflicts with --num_workers "
             "supervision (which spawns one ssh per worker 0..N-1); drop one"
         )
+    if auto_resume and not args.restart_on_failure:
+        raise ValueError(
+            "--auto_resume only acts on supervised RELAUNCHES — pass "
+            "--restart_on_failure N too, or the job dies on the first failure "
+            "without ever resuming"
+        )
 
-    def spawn(i: int):
+    def spawn(i: int, attempt: int = 1):
+        # relaunch attempts resume from the newest valid checkpoint: the
+        # first attempt's command is untouched, every later one carries
+        # `--resume auto` for the training script's CheckpointManager
+        worker_command = (
+            assemble_worker_command(args, resume=True)
+            if auto_resume and attempt > 1
+            else command
+        )
         cmd = build_gcloud_ssh_cmd(
-            args.tpu_name, args.tpu_zone, command, worker=str(i), use_alpha=args.use_alpha
+            args.tpu_name, args.tpu_zone, worker_command, worker=str(i), use_alpha=args.use_alpha
         )
         return subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True

@@ -1,0 +1,45 @@
+"""`accelerate-tpu verify-checkpoint <dir>` — offline checkpoint validation.
+
+Validates a checkpoint directory against its ``manifest.json`` (completeness,
+per-file sizes, CRC32 checksums) without touching an accelerator: the CI/ops
+counterpart of the commit protocol in ``fault_tolerance.py``. Exit code 0
+means the checkpoint is complete and resumable; 1 lists every problem found.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "verify-checkpoint",
+        help="Validate a checkpoint directory's manifest offline (sizes + checksums)",
+    )
+    parser.add_argument("checkpoint_dir", help="Checkpoint directory (contains manifest.json)")
+    parser.add_argument(
+        "--no-checksums",
+        action="store_true",
+        help="Skip CRC32 verification (sizes/completeness only — fast on huge checkpoints)",
+    )
+    parser.set_defaults(func=run)
+    return parser
+
+
+def run(args) -> int:
+    from ..fault_tolerance import read_manifest, verify_checkpoint
+
+    problems = verify_checkpoint(args.checkpoint_dir, check_checksums=not args.no_checksums)
+    if problems:
+        for problem in problems:
+            print(f"FAIL {args.checkpoint_dir}: {problem}", file=sys.stderr)
+        return 1
+    manifest = read_manifest(args.checkpoint_dir) or {}
+    files = manifest.get("files", {})
+    total = sum(meta.get("size", 0) for meta in files.values())
+    step = manifest.get("step")
+    detail = f"{len(files)} files, {total / 2**20:.1f} MiB"
+    if step is not None:
+        detail += f", step {step}"
+    print(f"OK {args.checkpoint_dir}: {detail}")
+    return 0

@@ -307,6 +307,7 @@ class DataLoaderStateMixin:
     def reset(self):
         self.end_of_dataloader = False
         self.remainder = -1
+        self.batches_yielded = 0
 
 
 class BaseDataLoader(DataLoaderStateMixin):
@@ -328,7 +329,19 @@ class BaseDataLoader(DataLoaderStateMixin):
         self.prefetch = prefetch
         self.gradient_state = GradientState()
         self.state = PartialState()
+        self.epoch = 0
+        # mid-epoch resume bookkeeping (fault_tolerance.CheckpointManager):
+        # position = batches already consumed this epoch, counting the batches
+        # a skip_first_batches loader skipped (its _skip_offset)
+        self._skip_offset = 0
         self.reset()
+
+    @property
+    def position(self) -> int:
+        """Batches consumed this epoch (absolute: a resumed loader counts the
+        batches it skipped) — what CheckpointManager snapshots so a resumed
+        run's next batch is bit-exact the one this run would have consumed."""
+        return self._skip_offset + self.batches_yielded
 
     def _globalize(self, local_batch):
         """Host-local numpy batch → global sharded jax.Array tree."""
@@ -363,11 +376,13 @@ class BaseDataLoader(DataLoaderStateMixin):
             have_current = False
             for nxt in batches:
                 if have_current:
+                    self.batches_yielded += 1
                     yield self._globalize(current)
                 current = nxt
                 have_current = True
             if have_current:
                 self._mark_last_batch()
+                self.batches_yielded += 1
                 yield self._globalize(current)
         finally:
             self.end()
@@ -419,6 +434,7 @@ class BaseDataLoader(DataLoaderStateMixin):
                     raise payload
                 if is_last:
                     self._mark_last_batch()
+                self.batches_yielded += 1
                 yield payload
                 if is_last:
                     break
@@ -515,6 +531,7 @@ class IterableDataLoaderShard(BaseDataLoader):
         return ds.batch_size if ds.split_batches else ds.batch_size * ds.num_processes
 
     def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
         self.dataset.set_epoch(epoch)
 
     def _local_batches(self):
@@ -564,6 +581,7 @@ class DataLoaderDispatcher(BaseDataLoader):
         return self.batch_size * self.state.num_processes
 
     def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
 
@@ -776,13 +794,17 @@ class SkipDataLoader(BaseDataLoader):
         super().__init__(device_placement=False)  # inner loader already globalizes
         self.inner_loader = inner_loader
         self.skip_batches = skip_batches
+        self._skip_offset = skip_batches  # position stays absolute for resume
+        self.epoch = getattr(inner_loader, "epoch", 0)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["inner_loader"], name)
 
     def __iter__(self):
+        self.batches_yielded = 0
         for i, batch in enumerate(self.inner_loader):
             if i >= self.skip_batches:
+                self.batches_yielded += 1
                 yield batch
 
 
@@ -792,7 +814,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
     if num_batches == 0:
         return dataloader
     if isinstance(dataloader, DataLoaderShard):
-        return DataLoaderShard(
+        skipped = DataLoaderShard(
             dataloader.dataset,
             batch_sampler=SkipBatchSampler(dataloader.batch_sampler, num_batches),
             collate_fn=dataloader.collate_fn,
@@ -800,4 +822,9 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             split_batches=dataloader.split_batches,
             prefetch=dataloader.prefetch,
         )
+        # position stays absolute so a save during the resumed epoch records
+        # the true batch index, not the count since the resume
+        skipped._skip_offset = num_batches
+        skipped.epoch = dataloader.epoch
+        return skipped
     return SkipDataLoader(dataloader, num_batches)

@@ -1,0 +1,591 @@
+"""Fault-tolerant checkpointing: atomic commits, preemption handling, auto-resume.
+
+Production pods are preemptible: a spot-VM SIGTERM or a crashed host must
+never cost more than the work since the last checkpoint, and must NEVER cost
+the run itself. The reference treats ``save_state`` as a best-effort in-place
+write — a kill mid-save can corrupt the newest checkpoint while the rotation
+logic has already deleted the previous good one. This module closes both
+holes with three cooperating pieces:
+
+1. **Atomic commit protocol** (used by ``checkpointing.save_accelerator_state``):
+   every save stages into ``<dir>.tmp``, a ``manifest.json`` records per-file
+   sizes + CRC32 checksums + step/topology metadata, all hosts barrier, and
+   only then does process 0 rename the staging dir to its final name. Old
+   checkpoints rotate strictly AFTER the new one is committed. A kill at any
+   instant therefore leaves at least one complete, verifiable checkpoint; the
+   torn ``.tmp`` dir is garbage-collected on the next save.
+
+2. **Preemption handling** (``CheckpointManager``): a SIGTERM/SIGINT handler
+   flips a flag — it does NOT save from the handler, because mid-step state is
+   inconsistent — and ``should_save()`` turns the flag into exactly one save
+   at the next step boundary. Multi-host agreement rides
+   ``PartialState.any_process``: the grace-window signal may land on one host
+   only, and every host must decide to save at the same boundary or the save
+   barrier deadlocks. Saves are wrapped in ``retry_transient_io`` so GCS-fuse
+   style flaky writes back off and retry instead of killing the run.
+
+3. **Auto-resume** (``latest_valid`` / ``CheckpointManager.resume``): scan the
+   checkpoint dir newest-first, validate manifests (skipping ``.tmp`` and torn
+   dirs), ``load_state`` the newest valid one, and rewind the dataloaders via
+   ``set_epoch`` + ``skip_first_batches`` so the next batch is bit-exact the
+   one the dead run would have consumed. ``resume_from_checkpoint="auto"``
+   needs zero operator input — which is what lets ``pod-launch --auto_resume``
+   restart a dead worker unattended.
+
+The manifest/commit protocol assumes the checkpoint directory is a shared
+filesystem across hosts (GCS-fuse / NFS — the pod norm). On non-shared
+filesystems each non-main host commits its local staging dir too (its RNG
+file lives there), without a manifest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.constants import (
+    CHECKPOINT_DIR_PREFIX,
+    CHECKPOINT_MANIFEST_NAME,
+    CHECKPOINT_TMP_SUFFIX,
+)
+from .utils.memory import retry_transient_io
+
+logger = get_logger(__name__)
+
+MANIFEST_FORMAT_VERSION = 1
+
+# Test seam: when set, called as ``hook(stage, directory)`` at the named
+# points of the commit protocol ("staged" = all state files written,
+# "manifest" = manifest written, both before the rename). Crash-injection
+# tests raise from here to simulate a kill at that exact instant.
+fault_injection_hook: Optional[Callable[[str, str], None]] = None
+
+
+def _run_fault_hook(stage: str, directory: str) -> None:
+    if fault_injection_hook is not None:
+        fault_injection_hook(stage, directory)
+
+
+# ---------------------------------------------------------------------------
+# manifest: build / write / verify
+# ---------------------------------------------------------------------------
+
+
+def _file_crc32(path: str, chunk_bytes: int = 1 << 20) -> str:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return format(crc & 0xFFFFFFFF, "08x")
+
+
+def build_manifest(directory: str, step: Optional[int] = None, metadata: Optional[dict] = None) -> dict:
+    """Walk ``directory`` and record every file's size + CRC32, plus the
+    step/topology metadata a resume needs to sanity-check compatibility."""
+    files: dict[str, dict] = {}
+    for root, _, names in os.walk(directory):
+        for name in sorted(names):
+            if name == CHECKPOINT_MANIFEST_NAME:
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, directory)
+            files[rel] = {"size": os.path.getsize(full), "crc32": _file_crc32(full)}
+    state = PartialState()
+    manifest = {
+        "format_version": MANIFEST_FORMAT_VERSION,
+        "step": step,
+        "files": files,
+        "topology": {
+            "num_processes": state.num_processes,
+            "num_devices": state.num_devices,
+            "mesh": {axis: int(size) for axis, size in state.mesh.shape.items()},
+        },
+        "created": time.time(),
+    }
+    if metadata:
+        manifest["metadata"] = metadata
+    return manifest
+
+
+@retry_transient_io
+def write_manifest(directory: str, manifest: dict) -> str:
+    """Durably write ``manifest.json`` (fsync'd: the rename that follows must
+    never promote a dir whose manifest is still in the page cache)."""
+    path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_checkpoint(directory: str, check_checksums: bool = True) -> list[str]:
+    """Validate a checkpoint directory against its manifest.
+
+    Returns a list of human-readable problems — empty means the checkpoint is
+    complete and verifiable. Used by ``latest_valid`` (skip torn dirs), the
+    ``verify-checkpoint`` CLI, and tests.
+    """
+    if not os.path.isdir(directory):
+        return [f"{directory} is not a directory"]
+    if directory.rstrip(os.sep).endswith(CHECKPOINT_TMP_SUFFIX):
+        return [f"{directory} is an uncommitted staging dir ({CHECKPOINT_TMP_SUFFIX})"]
+    path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
+    if not os.path.exists(path):
+        return [f"missing {CHECKPOINT_MANIFEST_NAME}"]
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable manifest: {e}"]
+    files = manifest.get("files")
+    if not isinstance(files, dict) or not files:
+        return ["manifest lists no files"]
+    problems = []
+    for rel, meta in files.items():
+        full = os.path.join(directory, rel)
+        if not os.path.exists(full):
+            problems.append(f"missing file {rel}")
+            continue
+        size = os.path.getsize(full)
+        if size != meta.get("size"):
+            problems.append(f"size mismatch for {rel}: manifest {meta.get('size')}, on disk {size}")
+            continue
+        if check_checksums and _file_crc32(full) != meta.get("crc32"):
+            problems.append(f"checksum mismatch for {rel}")
+    return problems
+
+
+def is_valid_checkpoint(directory: str) -> bool:
+    return not verify_checkpoint(directory)
+
+
+# ---------------------------------------------------------------------------
+# atomic commit + torn-dir garbage collection
+# ---------------------------------------------------------------------------
+
+
+def staging_dir_for(final_dir: str) -> str:
+    return final_dir.rstrip(os.sep) + CHECKPOINT_TMP_SUFFIX
+
+
+@retry_transient_io
+def commit_checkpoint(staging_dir: str, final_dir: str) -> str:
+    """Atomically promote a complete staging dir to its final name.
+
+    The rename is the commit point: before it, readers see only the previous
+    checkpoints; after it, the new one is complete (its manifest was fsync'd
+    first). Re-saving into an existing ``final_dir`` moves the old tree aside
+    before the rename so the swap stays a pair of renames, never a partial
+    in-place overwrite. The aside name ends in ``.old`` — deliberately NOT
+    the ``.tmp`` suffix ``garbage_collect_torn`` matches — so a kill between
+    the two renames (only the complete staging dir and the complete old dir
+    on disk, neither under the final name) leaves both copies recoverable
+    instead of feeding the old one to the next save's torn-dir GC.
+    """
+    doomed = final_dir.rstrip(os.sep) + ".old"
+    if os.path.exists(final_dir):
+        if os.path.exists(doomed):
+            shutil.rmtree(doomed, ignore_errors=True)
+        os.rename(final_dir, doomed)
+        os.rename(staging_dir, final_dir)
+    else:
+        os.rename(staging_dir, final_dir)
+    # with the new checkpoint committed, the old copy (this commit's aside,
+    # or one left by a previously interrupted commit) is no longer needed
+    shutil.rmtree(doomed, ignore_errors=True)
+    return final_dir
+
+
+def garbage_collect_torn(base: str) -> list[str]:
+    """Remove leftover ``*.tmp`` staging dirs under ``base`` — the debris of a
+    previous run killed mid-save. Called on the next save, so torn dirs never
+    accumulate and never shadow valid checkpoints."""
+    removed = []
+    if not os.path.isdir(base):
+        return removed
+    for name in os.listdir(base):
+        if name.endswith(CHECKPOINT_TMP_SUFFIX):
+            full = os.path.join(base, name)
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+                removed.append(full)
+                logger.info(f"Garbage-collected torn checkpoint staging dir {full}")
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# checkpoint discovery / auto-resume
+# ---------------------------------------------------------------------------
+
+
+def list_checkpoints(base: str) -> list[str]:
+    """Committed ``checkpoint_<n>`` dirs under ``base``, oldest→newest."""
+    if not os.path.isdir(base):
+        return []
+    entries = []
+    for name in os.listdir(base):
+        match = re.fullmatch(rf"{CHECKPOINT_DIR_PREFIX}_(\d+)", name)
+        if match and os.path.isdir(os.path.join(base, name)):
+            entries.append((int(match.group(1)), os.path.join(base, name)))
+    return [path for _, path in sorted(entries)]
+
+
+def latest_valid_checkpoint(base: str, check_checksums: bool = True) -> Optional[str]:
+    """Newest checkpoint under ``base`` whose manifest validates.
+
+    ``.tmp`` staging dirs never match the ``checkpoint_<n>`` pattern, and a
+    committed-but-torn dir (a manifest whose files fail verification —
+    possible only through external damage, since the commit protocol renames
+    after the manifest validates) is skipped with a warning rather than
+    resumed into a corrupt run.
+    """
+    for path in reversed(list_checkpoints(base)):
+        problems = verify_checkpoint(path, check_checksums=check_checksums)
+        if not problems:
+            return path
+        logger.warning(
+            f"Skipping invalid checkpoint {path}: {'; '.join(problems[:3])}"
+            + (f" (+{len(problems) - 3} more)" if len(problems) > 3 else "")
+        )
+    return None
+
+
+@dataclass
+class ResumePoint:
+    """What ``CheckpointManager.resume`` restored: the checkpoint path plus
+    the positions needed to rewind dataloaders to the exact next batch."""
+
+    path: str
+    step: int = 0
+    epoch: int = 0
+    dataloaders: list = field(default_factory=list)  # [{"epoch": e, "position": n}, ...]
+    metadata: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Owns a run's checkpoint lifecycle: periodic atomic saves, rotation,
+    preemption-triggered boundary saves, and auto-resume.
+
+    Canonical loop::
+
+        manager = CheckpointManager(accelerator, "ckpts", save_interval=500)
+        resume = manager.resume("auto")           # None on a fresh run
+        start_epoch = resume.epoch if resume else 0
+        step = resume.step if resume else 0
+        for epoch in range(start_epoch, num_epochs):
+            loader.set_epoch(epoch)
+            epoch_loader = manager.resumed_loader(loader, resume, epoch)
+            for batch in epoch_loader:
+                loss = train_step(batch)
+                step += 1
+                if manager.should_save(step):
+                    manager.save(step, epoch=epoch)
+                if manager.exit_requested:        # preemption save landed
+                    return
+            resume = None                          # later epochs start at 0
+    """
+
+    def __init__(
+        self,
+        accelerator: Any,
+        checkpoint_dir: Optional[str] = None,
+        save_interval: Optional[int] = None,
+        total_limit: Optional[int] = None,
+        sharded: bool = False,
+        handle_signals: tuple = (signal.SIGTERM, signal.SIGINT),
+        check_checksums: bool = True,
+        preemption_sync_every: int = 1,
+    ):
+        self.accelerator = accelerator
+        project = accelerator.project_configuration
+        if project.automatic_checkpoint_naming:
+            # the two naming schemes fight: save(step) would write
+            # checkpoint_<iteration> while returning/rotating checkpoint_<step>,
+            # and iteration resets on restart ("already exists" on the first
+            # post-resume save) — exactly what an unattended run cannot have
+            raise ValueError(
+                "CheckpointManager names checkpoints by training step and "
+                "cannot run with ProjectConfiguration(automatic_checkpoint_naming"
+                "=True); disable it — the manager handles naming and rotation."
+            )
+        self.checkpoint_dir = checkpoint_dir or os.path.join(project.project_dir or ".", "checkpoints")
+        self.save_interval = save_interval
+        self.total_limit = total_limit if total_limit is not None else project.total_limit
+        self.sharded = sharded
+        self.check_checksums = check_checksums
+        # multi-host: how often (in steps) should_save runs the collective
+        # preemption agreement. 1 = every step (tightest reaction); larger
+        # values amortize the allgather on big pods — keep it well under the
+        # grace window in steps. Single-host runs never pay a collective.
+        self.preemption_sync_every = max(int(preemption_sync_every), 1)
+        self._preempted = False
+        self._preempt_signum: Optional[int] = None
+        self._saved_on_preemption = False
+        self._prev_handlers: dict = {}
+        self._swapped_loaders: dict = {}  # id(original) -> wrapper in _dataloaders
+        if handle_signals:
+            self._install_handlers(handle_signals)
+
+    # -- preemption --------------------------------------------------------
+
+    def _install_handlers(self, signals_to_handle) -> None:
+        for sig in signals_to_handle:
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:
+                # not the main thread (notebook executors, test runners):
+                # preemption saves then need an explicit request_preemption()
+                logger.warning(
+                    "CheckpointManager could not install signal handlers outside "
+                    "the main thread; call request_preemption() manually."
+                )
+                break
+
+    def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
+        # Flag only — never save from a handler: the signal can land mid-step
+        # (half-applied optimizer update, in-flight collective). should_save()
+        # converts the flag into exactly one save at the next step boundary,
+        # inside the spot-VM grace window.
+        self._preempted = True
+        self._preempt_signum = signum
+
+    def request_preemption(self) -> None:
+        """Programmatic SIGTERM equivalent (tests, external schedulers)."""
+        self._preempted = True
+
+    @property
+    def preemption_requested(self) -> bool:
+        """Whether ANY host caught a preemption signal (collective-agreeing:
+        every host sees the same answer, so the save barrier cannot deadlock
+        when the grace signal lands on a single worker)."""
+        return PartialState().any_process(self._preempted)
+
+    @property
+    def exit_requested(self) -> bool:
+        """True once the preemption-triggered boundary save has landed — the
+        loop should exit cleanly (the supervisor restarts with auto-resume)."""
+        return self._saved_on_preemption
+
+    def restore_signal_handlers(self) -> None:
+        for sig, handler in self._prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except ValueError:
+                pass
+        self._prev_handlers.clear()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.restore_signal_handlers()
+
+    # -- save --------------------------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        """True at a periodic boundary OR when a preemption is pending (the
+        latter exactly once — after the preemption save lands, further steps
+        should not happen; see ``exit_requested``).
+
+        The preemption check is collective on multi-host; it runs only on
+        steps where ``step % preemption_sync_every == 0`` — a gate every host
+        evaluates identically, so the allgather stays aligned across the
+        fleet while big pods avoid paying it every step.
+        """
+        if (
+            not self._saved_on_preemption
+            and step % self.preemption_sync_every == 0
+            and self.preemption_requested
+        ):
+            return True
+        return (
+            self.save_interval is not None
+            and step > 0
+            and step % self.save_interval == 0
+        )
+
+    def save_on_preemption(self, step: int, epoch: int = 0, metadata: Optional[dict] = None) -> bool:
+        """Convenience for loops that handle preemption separately from
+        periodic saves: performs the (single) boundary save if a preemption is
+        pending, and returns True when the caller should exit cleanly."""
+        if self.preemption_requested and not self._saved_on_preemption:
+            self.save(step, epoch=epoch, metadata=metadata)
+        return self.exit_requested
+
+    def _dataloader_positions(self) -> list[dict]:
+        positions = []
+        for loader in getattr(self.accelerator, "_dataloaders", []):
+            positions.append(
+                {
+                    "epoch": int(getattr(loader, "epoch", 0)),
+                    "position": int(getattr(loader, "position", 0)),
+                }
+            )
+        return positions
+
+    def save(self, step: int, epoch: int = 0, metadata: Optional[dict] = None) -> str:
+        """One atomic checkpoint: garbage-collect torn staging dirs, stage +
+        commit ``checkpoint_<step>``, then rotate old checkpoints (strictly
+        after the commit — the previous good checkpoint survives any kill
+        during this call). Transient I/O errors back off and retry."""
+        state = PartialState()
+        if state.is_main_process:
+            garbage_collect_torn(self.checkpoint_dir)
+        target = os.path.join(self.checkpoint_dir, f"{CHECKPOINT_DIR_PREFIX}_{step}")
+        meta = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "dataloaders": self._dataloader_positions(),
+        }
+        if metadata:
+            meta.update(metadata)
+        # Whole-call retry only when single-process: save_state is a barrier
+        # sequence, and re-entering it on ONE host while the others wait at a
+        # later barrier would deadlock the fleet. Multi-host runs still get
+        # the per-operation retries inside the commit protocol
+        # (write_manifest / commit_checkpoint).
+        save = self.accelerator.save_state
+        if state.num_processes == 1:
+            save = retry_transient_io(save)
+        save(target, sharded=self.sharded, manifest_metadata=meta)
+        # collective check, not the host-local flag: the signal landed on one
+        # host, but EVERY host must flip exit_requested or the others keep
+        # looping into a deadlocked barrier
+        if self.preemption_requested:
+            self._saved_on_preemption = True
+            logger.info(
+                f"Preemption save committed at step {step} → {target}; exit when convenient."
+            )
+        self._rotate(keep=target)
+        return target
+
+    def _rotate(self, keep: str) -> None:
+        if self.total_limit is None:
+            return
+        state = PartialState()
+        if state.is_main_process:
+            existing = list_checkpoints(self.checkpoint_dir)
+            doomed = [p for p in existing if p != keep]
+            for stale in doomed[: max(len(existing) - self.total_limit, 0)]:
+                logger.info(f"Rotating out {stale} (total_limit={self.total_limit})")
+                shutil.rmtree(stale, ignore_errors=True)
+        state.wait_for_everyone()
+
+    # -- resume ------------------------------------------------------------
+
+    def latest_valid(self) -> Optional[str]:
+        """Newest checkpoint whose manifest validates (torn/.tmp dirs skipped)."""
+        return latest_valid_checkpoint(self.checkpoint_dir, check_checksums=self.check_checksums)
+
+    def resume(self, resume_from_checkpoint: "str | None" = "auto") -> Optional[ResumePoint]:
+        """Restore the run: ``"auto"`` loads the newest valid checkpoint (None
+        if there is none — a fresh run), a path loads that checkpoint after
+        validating it. Restores model/optimizer/scheduler/RNG via
+        ``load_state`` and returns the positions for dataloader rewind."""
+        if resume_from_checkpoint in (None, False):
+            return None
+        state = PartialState()
+        if resume_from_checkpoint == "auto":
+            # ONE fleet-wide decision: process 0 scans + validates and its
+            # answer binds every host. Independent per-host scans could
+            # diverge (per-host bit-rot, filesystem propagation lag) and a
+            # host resuming while another starts fresh deadlocks load_state's
+            # barrier. This makes resume() a collective — call it on every
+            # host, like save().
+            path = self.latest_valid() if state.is_main_process else None
+            if state.num_processes > 1:
+                from .ops.operations import broadcast_object_list
+
+                path = broadcast_object_list([path])[0]
+            if path is None:
+                logger.info(f"No valid checkpoint under {self.checkpoint_dir}; starting fresh.")
+                return None
+        else:
+            path = resume_from_checkpoint
+            problems = verify_checkpoint(path, check_checksums=self.check_checksums)
+            if problems:
+                raise ValueError(
+                    f"Refusing to resume from {path}: {'; '.join(problems[:5])}"
+                )
+        # same single-process-only whole-call retry rationale as save()
+        load = self.accelerator.load_state
+        if PartialState().num_processes == 1:
+            load = retry_transient_io(load)
+        load(path)
+        manifest = read_manifest(path) or {}
+        meta = manifest.get("metadata", {})
+        point = ResumePoint(
+            path=path,
+            step=int(meta.get("step", manifest.get("step") or 0)),
+            epoch=int(meta.get("epoch", 0)),
+            dataloaders=meta.get("dataloaders", []),
+            metadata=meta,
+        )
+        logger.info(f"Resumed from {path} (step {point.step}, epoch {point.epoch})")
+        return point
+
+    def resumed_loader(self, loader, resume: Optional[ResumePoint], epoch: int, index: int = 0):
+        """The loader to iterate for ``epoch`` after a resume: mid-epoch, the
+        first ``position`` batches are skipped (``set_epoch`` + the seedable
+        sampler make the underlying permutation identical, so the next batch
+        is bit-exact the one the dead run would have consumed); any other
+        epoch iterates the loader unchanged. Call it every epoch (as the
+        canonical loop does) — that also keeps the manager's position
+        tracking pointed at the loader actually being iterated."""
+        loaders = getattr(self.accelerator, "_dataloaders", None)
+        # Undo a previous epoch's swap: once the resumed epoch is over, saves
+        # must record the LIVE loader's epoch/position, not the stale wrapper.
+        prev = self._swapped_loaders.pop(id(loader), None)
+        if prev is not None and loaders is not None and prev in loaders:
+            loaders[loaders.index(prev)] = loader
+        if resume is None or index >= len(resume.dataloaders):
+            return loader
+        info = resume.dataloaders[index]
+        if int(info.get("epoch", 0)) != epoch:
+            return loader
+        position = int(info.get("position", 0))
+        if position == 0:
+            return loader
+        from .data_loader import skip_first_batches
+
+        if hasattr(loader, "set_epoch"):
+            loader.set_epoch(epoch)
+        skipped = skip_first_batches(loader, position)
+        skipped._skip_offset = position  # later saves record the absolute position
+        skipped.epoch = epoch
+        # keep position tracking live for saves during the resumed epoch
+        if loaders is not None and loader in loaders:
+            loaders[loaders.index(loader)] = skipped
+            self._swapped_loaders[id(loader)] = skipped
+        return skipped

@@ -125,17 +125,24 @@ class AcceleratedOptimizer:
             # DeepSpeed/FSDP cpu_offload), moved with device_put outside jit
             # (memory-kind annotations inside jit trip XLA's SPMD partitioner).
             # Scalars (step counters) stay in device memory — pinning them
-            # saves nothing.
-            self._opt_state_shardings = jax.tree.map(
-                lambda s, shape: (
-                    NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
-                    if len(shape.shape) > 0
-                    else s
-                ),
-                self._opt_state_shardings,
-                state_shapes,
-            )
-            self.opt_state = jax.device_put(self.opt_state, self._opt_state_shardings)
+            # saves nothing. Backends without a "pinned_host" memory space
+            # (CPU on older jax — where "device" memory already IS host RAM)
+            # skip the annotation: offload degrades to a placement no-op.
+            try:
+                kinds = {m.kind for m in mesh.devices.flat[0].addressable_memories()}
+            except Exception:
+                kinds = {"pinned_host"}
+            if "pinned_host" in kinds:
+                self._opt_state_shardings = jax.tree.map(
+                    lambda s, shape: (
+                        NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
+                        if len(shape.shape) > 0
+                        else s
+                    ),
+                    self._opt_state_shardings,
+                    state_shapes,
+                )
+                self.opt_state = jax.device_put(self.opt_state, self._opt_state_shardings)
 
         self._grads = None  # accumulated (sum) grads, lazily allocated
         self._accum_count = 0

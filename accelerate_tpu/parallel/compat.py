@@ -1,0 +1,38 @@
+"""jax-version compatibility for the parallel modules.
+
+``jax.shard_map`` became a public top-level API in newer jax; on 0.4.x only
+``jax.experimental.shard_map.shard_map`` exists, and it spells the
+manual-axes selection differently (``auto`` = the complement set, instead of
+``axis_names``). Both call patterns used in this package — direct call and
+``partial(shard_map, mesh=..., ...)`` decorator — go through this shim.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: public API with axis_names
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental API with auto=<complement>
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None, **kwargs):
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # 0.4.x's replication checker false-positives on scan carries over
+        # partially-auto meshes ("mismatched replication types"); jax's own
+        # error message prescribes check_rep=False as the workaround.
+        kwargs.setdefault("check_rep", False)
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+
+import jax as _jax
+
+# Replication ("vma") typing of values inside shard_map manual regions, and
+# the jax.lax.pcast that promotes replicated→varying, only exist on newer
+# jax. On 0.4.x (check_rep=False) every value in a manual region is already
+# treated as varying and shard_map inserts the transpose-psums itself, so
+# callers skip the explicit pcast when this is False.
+HAS_PCAST = hasattr(_jax.lax, "pcast")
+
+__all__ = ["shard_map", "HAS_PCAST"]

@@ -51,6 +51,16 @@ def _init_timeout_kwargs() -> dict[str, int]:
     return {"initialization_timeout": int(timeout)} if timeout else {}
 
 
+def distributed_is_initialized() -> bool:
+    """Whether the jax.distributed rendezvous already ran (version-portable:
+    ``jax.distributed.is_initialized`` only exists on newer jax)."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed as _distributed
+
+    return _distributed.global_state.client is not None
+
+
 class PartialState:
     """Topology bootstrap singleton.
 
@@ -95,7 +105,7 @@ class PartialState:
             # Probing jax.process_count() first would initialize the local
             # backend and defeat distributed init, so ask the distributed
             # module itself whether it is live.
-            if not jax.distributed.is_initialized():
+            if not distributed_is_initialized():
                 jax.distributed.initialize(
                     coordinator_address=coordinator,
                     num_processes=num_processes,
@@ -106,7 +116,7 @@ class PartialState:
             # pod-launch path: no explicit coordinator — every worker runs the
             # identical command and jax self-discovers coordinator/process_id/
             # process count from the TPU pod metadata (argless initialize)
-            if not jax.distributed.is_initialized():
+            if not distributed_is_initialized():
                 jax.distributed.initialize(**_init_timeout_kwargs())
         self.backend = "xla"
         self.device = jax.local_devices()[0]
@@ -194,6 +204,22 @@ class PartialState:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+
+    def any_process(self, flag: bool) -> bool:
+        """Logical OR of a host-local flag across all processes.
+
+        The preemption-agreement primitive (fault_tolerance.py): a spot-VM
+        SIGTERM lands on ONE host's grace window, but every host must decide
+        to checkpoint at the same step boundary — otherwise the save's
+        collective barrier deadlocks. This is a collective: either all hosts
+        call it at the same point, or none do.
+        """
+        if self.num_processes <= 1:
+            return bool(flag)
+        from jax.experimental import multihost_utils
+
+        votes = multihost_utils.process_allgather(np.asarray([1 if flag else 0], np.int32))
+        return bool(np.asarray(votes).sum() > 0)
 
     @contextmanager
     def main_process_first(self):

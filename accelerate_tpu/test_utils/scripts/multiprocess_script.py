@@ -25,8 +25,15 @@ def main():
     if force_cpu:
         import jax
 
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(force_cpu)}"
+        ).strip()
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(force_cpu))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(force_cpu))
+        except AttributeError:
+            pass  # older jax: XLA_FLAGS above forces the host device count
 
     import optax
 

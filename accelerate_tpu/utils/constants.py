@@ -43,5 +43,12 @@ ENV_PREFIX = "ACCELERATE_"
 
 CHECKPOINT_DIR_PREFIX = "checkpoint"
 
+# Fault-tolerant checkpointing (fault_tolerance.py): saves stage into
+# `<dir>.tmp` and rename into place only after the manifest validates, so a
+# kill at any instant leaves either the complete old or the complete new
+# checkpoint — never a torn one.
+CHECKPOINT_TMP_SUFFIX = ".tmp"
+CHECKPOINT_MANIFEST_NAME = "manifest.json"
+
 # Default rendezvous for multi-host jax.distributed bootstrap.
 DEFAULT_COORDINATOR_PORT = 8476

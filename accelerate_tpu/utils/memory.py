@@ -3,13 +3,20 @@
 Parity: reference utils/memory.py (release_memory:29, should_reduce_batch_size:69,
 find_executable_batch_size:87). The OOM classifier keys on XLA's
 RESOURCE_EXHAUSTED instead of CUDA out-of-memory strings.
+
+The same classify-and-retry shape covers transient filesystem failures
+(``is_transient_io_error`` / ``retry_transient_io``): GCS-fuse and NFS mounts
+drop writes with EIO/ESTALE/timeout-style errors that succeed on retry, and
+checkpoint saves must ride those out rather than abort a multi-hour run.
 """
 
 from __future__ import annotations
 
+import errno
 import functools
 import gc
 import inspect
+import time
 from typing import Callable
 
 import jax
@@ -39,6 +46,83 @@ def should_reduce_batch_size(exception: Exception) -> bool:
         text = str(exception)
         return any(marker in text for marker in _OOM_MARKERS)
     return False
+
+
+# errno values + message markers that mark an I/O failure as *transient* —
+# the retryable weather of network filesystems (GCS-fuse, NFS), not a bug.
+_TRANSIENT_IO_ERRNOS = frozenset(
+    code
+    for code in (
+        errno.EIO,
+        errno.EAGAIN,
+        errno.EBUSY,
+        errno.ETIMEDOUT,
+        getattr(errno, "ESTALE", None),  # NFS/FUSE stale handle
+        getattr(errno, "EREMOTEIO", None),
+    )
+    if code is not None
+)
+_TRANSIENT_IO_MARKERS = (
+    "Input/output error",
+    "Resource temporarily unavailable",
+    "Stale file handle",
+    "Transport endpoint is not connected",
+    "Connection reset",
+    "Connection timed out",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "Too Many Requests",  # GCS 429 rate limiting
+    "Service Unavailable",  # GCS 503 (bare "503" would match file paths)
+)
+
+
+def is_transient_io_error(exception: Exception) -> bool:
+    """Classify an exception as flaky-filesystem weather worth retrying.
+
+    Same shape as ``should_reduce_batch_size``: a narrow classifier that the
+    retry wrapper consults, so genuine bugs (ENOENT, EACCES, corrupt data)
+    propagate immediately. For an OSError carrying an errno, the errno is
+    authoritative — str(OSError) includes the file path, and marker matching
+    against a path (".../checkpoint_429/...") must never flip the verdict.
+    """
+    if isinstance(exception, OSError):
+        if exception.errno is not None:
+            return exception.errno in _TRANSIENT_IO_ERRNOS
+        return any(marker in str(exception) for marker in _TRANSIENT_IO_MARKERS)
+    if isinstance(exception, RuntimeError):
+        return any(marker in str(exception) for marker in _TRANSIENT_IO_MARKERS)
+    return False
+
+
+def retry_transient_io(
+    function: Callable | None = None,
+    max_attempts: int = 4,
+    base_delay: float = 0.5,
+    max_delay: float = 8.0,
+):
+    """Decorator retrying ``function`` on transient I/O errors with exponential
+    backoff (mirrors ``find_executable_batch_size``'s classify-and-retry loop,
+    with sleep-and-double in place of halve-the-batch). Non-transient errors
+    and the final attempt's failure propagate unchanged.
+    """
+    if function is None:
+        return functools.partial(
+            retry_transient_io, max_attempts=max_attempts, base_delay=base_delay, max_delay=max_delay
+        )
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        delay = base_delay
+        for attempt in range(max_attempts):
+            try:
+                return function(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - classifier decides
+                if attempt == max_attempts - 1 or not is_transient_io_error(e):
+                    raise
+                time.sleep(min(delay, max_delay))
+                delay *= 2
+
+    return wrapper
 
 
 def find_executable_batch_size(

@@ -28,7 +28,10 @@ os.environ["PYTHONPATH"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)  # works even when XLA_FLAGS was read too early
+try:
+    jax.config.update("jax_num_cpu_devices", 8)  # works even when XLA_FLAGS was read too early
+except AttributeError:
+    pass  # older jax: XLA_FLAGS above already forced the 8-device host platform
 
 import pytest
 

@@ -152,6 +152,247 @@ def test_resave_other_format_does_not_shadow(tmp_path):
     )
 
 
+# ---------------------------------------------------------------------------
+# crash injection: a kill at any instant of save_state must never lose the run
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_save_keeps_previous_checkpoint_resumable(tmp_path):
+    """Kill while staging (a truncated file in the .tmp dir): latest_valid()
+    skips the torn dir, load_state restores the previous checkpoint, and the
+    next save garbage-collects the debris."""
+    from accelerate_tpu import CheckpointManager, fault_tolerance
+
+    acc, model, opt = _make(4)
+    batch = _batch()
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    acc.backward(_loss, batch)
+    opt.step()
+    opt.zero_grad()
+    good = jax.device_get(model.params)
+    manager.save(step=1)
+    assert manager.latest_valid() == str(tmp_path / "checkpoint_1")
+
+    acc.backward(_loss, batch)
+    opt.step()
+    opt.zero_grad()
+
+    def tear(stage, directory):
+        if stage == "staged":
+            victim = os.path.join(directory, "model_0.safetensors")
+            if not os.path.exists(victim):
+                victim = os.path.join(directory, "model_0.npz")
+            with open(victim, "r+b") as f:
+                f.truncate(8)
+            raise RuntimeError("simulated kill mid-save")
+
+    fault_tolerance.fault_injection_hook = tear
+    try:
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            manager.save(step=2)
+    finally:
+        fault_tolerance.fault_injection_hook = None
+
+    # torn staging dir on disk, but discovery never surfaces it
+    assert glob.glob(str(tmp_path / "checkpoint_2.tmp"))
+    assert not (tmp_path / "checkpoint_2").exists()
+    assert manager.latest_valid() == str(tmp_path / "checkpoint_1")
+
+    _reset()
+    acc2, model2, _ = _make(4)
+    manager2 = CheckpointManager(acc2, checkpoint_dir=str(tmp_path), handle_signals=())
+    resume = manager2.resume("auto")
+    assert resume is not None and resume.step == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(model2.params)["w"]), np.asarray(good["w"])
+    )
+    # the next save garbage-collects the torn dir
+    manager2.save(step=3)
+    assert not glob.glob(str(tmp_path / "*.tmp"))
+    assert manager2.latest_valid() == str(tmp_path / "checkpoint_3")
+
+
+def test_kill_after_manifest_before_rename_is_skipped(tmp_path):
+    """A staging dir that is COMPLETE (manifest written) but never renamed is
+    still invisible to auto-resume: commit is the rename, nothing earlier."""
+    from accelerate_tpu import CheckpointManager, fault_tolerance, latest_valid_checkpoint
+
+    acc, model, opt = _make(2)
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    manager.save(step=1)
+
+    def kill_before_rename(stage, directory):  # noqa: ARG001
+        if stage == "manifest":
+            raise RuntimeError("simulated kill before rename")
+
+    fault_tolerance.fault_injection_hook = kill_before_rename
+    try:
+        with pytest.raises(RuntimeError, match="before rename"):
+            manager.save(step=2)
+    finally:
+        fault_tolerance.fault_injection_hook = None
+    assert (tmp_path / "checkpoint_2.tmp" / "manifest.json").exists()
+    assert latest_valid_checkpoint(str(tmp_path)) == str(tmp_path / "checkpoint_1")
+
+
+def test_externally_damaged_checkpoint_is_skipped(tmp_path):
+    """Bit-rot / partial deletion AFTER commit: the manifest checksums catch
+    it and latest_valid falls back to the older complete checkpoint."""
+    from accelerate_tpu import CheckpointManager
+
+    acc, model, opt = _make(2)
+    batch = _batch()
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    manager.save(step=1)
+    acc.backward(_loss, batch)
+    opt.step()
+    opt.zero_grad()
+    manager.save(step=2)
+    assert manager.latest_valid() == str(tmp_path / "checkpoint_2")
+
+    # flip bytes in the newest checkpoint's weights file
+    victims = glob.glob(str(tmp_path / "checkpoint_2" / "model_0.*"))
+    with open(victims[0], "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff" * 16)
+    assert manager.latest_valid() == str(tmp_path / "checkpoint_1")
+
+
+def test_sigterm_triggers_one_boundary_save_and_resume_is_bit_exact(tmp_path):
+    """SIGTERM mid-loop → exactly one save at the next step boundary, loop
+    exits; a fresh process resuming with "auto" sees the SAME next batch
+    (set_epoch + seedable sampler + skip_first_batches), bit for bit."""
+    import signal
+
+    from accelerate_tpu import CheckpointManager
+
+    def make_loader(acc):
+        data = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+        return acc.prepare_data_loader(
+            [{"x": row} for row in data], batch_size=8, shuffle=True, seed=123
+        )
+
+    # reference: the batch sequence of an uninterrupted epoch
+    acc, model, opt = _make(2)
+    loader = make_loader(acc)
+    reference = [np.asarray(b["x"]) for b in loader]
+
+    _reset()
+    acc, model, opt = _make(2)
+    loader = make_loader(acc)
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), save_interval=100)
+    try:
+        saves = 0
+        step = 0
+        exited = False
+        loader.set_epoch(0)
+        for batch in loader:
+            step += 1
+            if step == 3:
+                os.kill(os.getpid(), signal.SIGTERM)  # handler flips the flag only
+            if manager.should_save(step):
+                manager.save(step, epoch=0)
+                saves += 1
+            if manager.exit_requested:
+                exited = True
+                break
+        assert exited and saves == 1 and step == 3
+        assert manager.latest_valid() == str(tmp_path / "checkpoint_3")
+    finally:
+        manager.restore_signal_handlers()
+
+    _reset()
+    acc2, model2, opt2 = _make(2)
+    loader2 = make_loader(acc2)
+    manager2 = CheckpointManager(acc2, checkpoint_dir=str(tmp_path), handle_signals=())
+    resume = manager2.resume("auto")
+    assert resume.step == 3 and resume.epoch == 0
+    assert resume.dataloaders == [{"epoch": 0, "position": 3}]
+    loader2.set_epoch(0)
+    resumed = manager2.resumed_loader(loader2, resume, epoch=0)
+    nxt = next(iter(resumed))
+    np.testing.assert_array_equal(np.asarray(nxt["x"]), reference[3])
+    # a save during the resumed epoch records the ABSOLUTE position
+    assert resumed.position == 4
+
+
+_PREEMPTIBLE_TRAIN_SCRIPT = """
+import os, signal, sys
+import numpy as np
+import optax
+import jax, jax.numpy as jnp
+from accelerate_tpu import Accelerator, CheckpointManager
+
+mode, ckpt_dir = sys.argv[1], sys.argv[2]  # mode: ref | run | resume
+
+class Tiny:
+    def init(self, rng): return {"w": jax.random.normal(rng, (8, 4), jnp.float32)}
+    @staticmethod
+    def apply(params, x): return x @ params["w"]
+
+def loss(params, batch): return jnp.mean(Tiny.apply(params, batch["x"]) ** 2)
+
+acc = Accelerator()
+model = acc.prepare(Tiny())
+opt = acc.prepare_optimizer(optax.sgd(1e-2))
+data = [{"x": np.arange(8, dtype=np.float32) * (i + 1)} for i in range(48)]
+loader = acc.prepare_data_loader(data, batch_size=8, shuffle=True, seed=7)
+manager = CheckpointManager(acc, checkpoint_dir=ckpt_dir, save_interval=1000)
+resume = manager.resume("auto" if mode == "resume" else None)
+step = resume.step if resume else 0
+loader.set_epoch(0)
+epoch_loader = manager.resumed_loader(loader, resume, epoch=0)
+for batch in epoch_loader:
+    step += 1
+    print(f"STEP {step} SUM {float(jnp.sum(batch['x'])):.1f}", flush=True)
+    acc.backward(loss, batch)
+    opt.step()
+    opt.zero_grad()
+    if mode == "run" and step == 2:
+        os.kill(os.getpid(), signal.SIGTERM)  # fake the spot-VM grace signal
+    if manager.should_save(step):
+        manager.save(step, epoch=0)
+    if manager.exit_requested:
+        print("CLEAN_EXIT", flush=True)
+        sys.exit(0)
+print("DONE", flush=True)
+"""
+
+
+def test_sigterm_process_exits_cleanly_and_autoresumes(tmp_path):
+    """Full process-level drill: SIGTERM mid-epoch → exactly one boundary
+    save + exit code 0; a NEW process with resume="auto" continues at the
+    next step and consumes the same batches as an uninterrupted run."""
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "train.py"
+    script.write_text(_PREEMPTIBLE_TRAIN_SCRIPT)
+
+    def launch(mode, ckpt):
+        result = subprocess.run(
+            [_sys.executable, str(script), mode, str(ckpt)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        return result.stdout
+
+    reference = launch("ref", tmp_path / "ref_ckpts")
+    ref_steps = [l for l in reference.splitlines() if l.startswith("STEP")]
+    assert len(ref_steps) == 6  # 48 samples / batch 8
+
+    run_out = launch("run", tmp_path / "ckpts")
+    assert "CLEAN_EXIT" in run_out
+    assert [l for l in run_out.splitlines() if l.startswith("STEP")] == ref_steps[:2]
+    assert os.listdir(tmp_path / "ckpts") == ["checkpoint_2"]  # exactly one save
+
+    resume_out = launch("resume", tmp_path / "ckpts")
+    resumed_steps = [l for l in resume_out.splitlines() if l.startswith("STEP")]
+    # picks up at step 3 and the batch stream is bit-exact the reference's
+    assert resumed_steps == ref_steps[2:]
+    assert "DONE" in resume_out
+
+
 def test_unsharded_save_still_loads(tmp_path):
     """Default (gathered) path unchanged and auto-detected on load."""
     acc, model, opt = _make(4)

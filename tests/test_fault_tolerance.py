@@ -1,0 +1,486 @@
+"""Fault-tolerance subsystem: manifest build/verify, atomic commit, torn-dir
+GC, transient-I/O retry, preemption flags, rotation-after-commit, auto-resume
+(ISSUE 1 tentpole)."""
+
+import errno
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, CheckpointManager
+from accelerate_tpu.fault_tolerance import (
+    build_manifest,
+    commit_checkpoint,
+    garbage_collect_torn,
+    latest_valid_checkpoint,
+    list_checkpoints,
+    read_manifest,
+    staging_dir_for,
+    verify_checkpoint,
+    write_manifest,
+)
+from accelerate_tpu.state import PartialState
+from accelerate_tpu.utils.memory import is_transient_io_error, retry_transient_io
+
+
+class Tiny:
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (8, 4), jnp.float32)}
+
+    @staticmethod
+    def apply(params, x):
+        return x @ params["w"]
+
+
+def _loss(params, batch):
+    return jnp.mean(Tiny.apply(params, batch) ** 2)
+
+
+def _make_acc():
+    acc = Accelerator()
+    model = acc.prepare(Tiny())
+    opt = acc.prepare_optimizer(optax.sgd(1e-2))
+    return acc, model, opt
+
+
+def _write_dir(tmp_path, name="ckpt", files=("a.bin", "sub/b.bin")):
+    d = tmp_path / name
+    for rel in files:
+        full = d / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_bytes(os.urandom(256))
+    return str(d)
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_verify_ok(tmp_path):
+    PartialState()  # manifest records topology
+    d = _write_dir(tmp_path)
+    manifest = build_manifest(d, step=7, metadata={"epoch": 2})
+    write_manifest(d, manifest)
+    assert verify_checkpoint(d) == []
+    loaded = read_manifest(d)
+    assert loaded["step"] == 7
+    assert loaded["metadata"]["epoch"] == 2
+    assert set(loaded["files"]) == {"a.bin", os.path.join("sub", "b.bin")}
+    assert loaded["topology"]["num_devices"] == jax.device_count()
+
+
+def test_manifest_catches_truncation_bitrot_and_deletion(tmp_path):
+    PartialState()
+    d = _write_dir(tmp_path)
+    write_manifest(d, build_manifest(d))
+    # truncation → size mismatch
+    with open(os.path.join(d, "a.bin"), "r+b") as f:
+        f.truncate(10)
+    assert any("size mismatch" in p for p in verify_checkpoint(d))
+    # same-size bit flip → checksum mismatch
+    write_manifest(d, build_manifest(d))
+    with open(os.path.join(d, "a.bin"), "r+b") as f:
+        f.write(b"\x00\x01\x02\x03")
+    assert any("checksum mismatch" in p for p in verify_checkpoint(d))
+    # deletion → missing file
+    write_manifest(d, build_manifest(d))
+    os.remove(os.path.join(d, "sub", "b.bin"))
+    assert any("missing file" in p for p in verify_checkpoint(d))
+
+
+def test_verify_rejects_manifestless_and_tmp_dirs(tmp_path):
+    d = _write_dir(tmp_path)
+    assert any("manifest" in p for p in verify_checkpoint(d))
+    staged = _write_dir(tmp_path, name="checkpoint_3.tmp")
+    assert any("staging" in p for p in verify_checkpoint(staged))
+    assert verify_checkpoint(str(tmp_path / "nope")) != []
+
+
+def test_verify_checkpoint_without_checksums_still_checks_sizes(tmp_path):
+    PartialState()
+    d = _write_dir(tmp_path)
+    write_manifest(d, build_manifest(d))
+    with open(os.path.join(d, "a.bin"), "r+b") as f:
+        f.write(b"\xff\xfe\xfd\xfc")  # same size, different bytes
+    assert verify_checkpoint(d, check_checksums=False) == []
+    with open(os.path.join(d, "a.bin"), "r+b") as f:
+        f.truncate(10)
+    assert verify_checkpoint(d, check_checksums=False) != []
+
+
+# ---------------------------------------------------------------------------
+# commit + discovery
+# ---------------------------------------------------------------------------
+
+
+def test_commit_replaces_existing_dir_and_cleans_aside(tmp_path):
+    old = _write_dir(tmp_path, name="final", files=("old.bin",))
+    staged = _write_dir(tmp_path, name="final.tmp", files=("new.bin",))
+    assert staging_dir_for(old) == staged
+    commit_checkpoint(staged, old)
+    assert os.path.exists(os.path.join(old, "new.bin"))
+    assert not os.path.exists(os.path.join(old, "old.bin"))
+    assert not os.path.exists(staged)
+    assert not any(name.endswith((".tmp", ".old")) for name in os.listdir(tmp_path))
+
+
+def test_kill_between_commit_renames_is_recoverable(tmp_path):
+    """A kill after the old dir moved aside but before the staging rename
+    leaves BOTH complete copies on disk, and neither is eaten by the torn-dir
+    GC (the aside suffix is .old, not the .tmp the GC matches); the next
+    commit cleans the aside up."""
+    final = str(tmp_path / "ckpt")
+    # disk state of the interrupted instant: aside + staging, no final
+    _write_dir(tmp_path, name="ckpt.old", files=("old.bin",))
+    staged = _write_dir(tmp_path, name="ckpt.tmp", files=("new.bin",))
+    garbage_collect_torn(str(tmp_path))  # the next save's GC runs first
+    assert (tmp_path / "ckpt.old" / "old.bin").exists()  # old copy SURVIVES
+    assert not os.path.exists(staged)  # staging is torn debris, GC'd
+    # ... and a completed re-commit clears the stale aside
+    staged = _write_dir(tmp_path, name="ckpt.tmp", files=("newer.bin",))
+    commit_checkpoint(staged, final)
+    assert (tmp_path / "ckpt" / "newer.bin").exists()
+    assert not (tmp_path / "ckpt.old").exists()
+
+
+def test_garbage_collect_torn_only_removes_tmp_dirs(tmp_path):
+    _write_dir(tmp_path, name="checkpoint_1")
+    _write_dir(tmp_path, name="checkpoint_2.tmp")
+    _write_dir(tmp_path, name="other.tmp")
+    removed = garbage_collect_torn(str(tmp_path))
+    assert len(removed) == 2
+    assert (tmp_path / "checkpoint_1").exists()
+    assert not (tmp_path / "checkpoint_2.tmp").exists()
+
+
+def test_latest_valid_skips_torn_and_orders_numerically(tmp_path):
+    PartialState()
+    for step in (1, 2, 10):  # 10 > 2 numerically though "10" < "2" lexically
+        d = _write_dir(tmp_path, name=f"checkpoint_{step}")
+        write_manifest(d, build_manifest(d, step=step))
+    assert list_checkpoints(str(tmp_path))[-1].endswith("checkpoint_10")
+    assert latest_valid_checkpoint(str(tmp_path)).endswith("checkpoint_10")
+    # tear the newest: discovery falls back to checkpoint_2
+    os.remove(os.path.join(str(tmp_path / "checkpoint_10"), "a.bin"))
+    assert latest_valid_checkpoint(str(tmp_path)).endswith("checkpoint_2")
+    assert latest_valid_checkpoint(str(tmp_path / "empty-nowhere")) is None
+
+
+# ---------------------------------------------------------------------------
+# transient-I/O retry
+# ---------------------------------------------------------------------------
+
+
+def test_transient_io_classifier():
+    assert is_transient_io_error(OSError(errno.EIO, "Input/output error"))
+    assert is_transient_io_error(OSError(errno.ESTALE, "Stale file handle"))
+    assert is_transient_io_error(RuntimeError("DEADLINE_EXCEEDED while writing"))
+    assert is_transient_io_error(RuntimeError("HTTP 429 Too Many Requests"))
+    assert not is_transient_io_error(FileNotFoundError(2, "No such file"))
+    assert not is_transient_io_error(PermissionError(13, "denied"))
+    assert not is_transient_io_error(ValueError("bad value"))
+    # errno is authoritative for OSError: a path that CONTAINS marker-like
+    # digits must not flip a permanent error to transient
+    assert not is_transient_io_error(
+        FileNotFoundError(2, "No such file", "/ckpts/checkpoint_4290/model_0.safetensors")
+    )
+    assert not is_transient_io_error(
+        OSError(errno.EACCES, "Permission denied", "/data/Service Unavailable.bin")
+    )
+
+
+def test_retry_transient_io_backs_off_then_succeeds(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr("accelerate_tpu.utils.memory.time.sleep", sleeps.append)
+    calls = {"n": 0}
+
+    @retry_transient_io(base_delay=0.1)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(errno.EIO, "Input/output error")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [0.1, 0.2]  # exponential backoff
+
+
+def test_retry_transient_io_propagates_non_transient(monkeypatch):
+    monkeypatch.setattr("accelerate_tpu.utils.memory.time.sleep", lambda _s: None)
+    calls = {"n": 0}
+
+    @retry_transient_io
+    def broken():
+        calls["n"] += 1
+        raise FileNotFoundError(2, "No such file")
+
+    with pytest.raises(FileNotFoundError):
+        broken()
+    assert calls["n"] == 1  # no retry for a real bug
+
+
+def test_retry_transient_io_gives_up_after_max_attempts(monkeypatch):
+    monkeypatch.setattr("accelerate_tpu.utils.memory.time.sleep", lambda _s: None)
+    calls = {"n": 0}
+
+    @retry_transient_io(max_attempts=3)
+    def always_flaky():
+        calls["n"] += 1
+        raise OSError(errno.EIO, "Input/output error")
+
+    with pytest.raises(OSError):
+        always_flaky()
+    assert calls["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_should_save_interval_and_preemption(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = CheckpointManager(
+        acc, checkpoint_dir=str(tmp_path), save_interval=5, handle_signals=()
+    )
+    assert [s for s in range(1, 12) if manager.should_save(s)] == [5, 10]
+    manager.request_preemption()
+    assert manager.should_save(7)  # preemption overrides the interval
+    assert not manager.exit_requested
+    manager.save(7)
+    assert manager.exit_requested  # boundary save landed → exit cleanly
+    assert not manager.should_save(8)  # exactly ONE preemption save
+
+
+def test_save_rotates_only_after_commit(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = CheckpointManager(
+        acc, checkpoint_dir=str(tmp_path), total_limit=2, handle_signals=()
+    )
+    batch = jnp.ones((4, 8), jnp.float32)
+    for step in (1, 2, 3):
+        acc.backward(_loss, batch)
+        opt.step()
+        opt.zero_grad()
+        manager.save(step)
+    kept = list_checkpoints(str(tmp_path))
+    assert [os.path.basename(p) for p in kept] == ["checkpoint_2", "checkpoint_3"]
+    assert verify_checkpoint(kept[-1]) == []
+
+
+def test_resume_none_modes_and_fresh_run(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    assert manager.resume(None) is None
+    assert manager.resume(False) is None
+    assert manager.resume("auto") is None  # nothing saved yet: fresh run
+
+
+def test_resume_explicit_path_refuses_torn_checkpoint(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    manager.save(step=1)
+    target = str(tmp_path / "checkpoint_1")
+    victim = next(
+        os.path.join(target, n) for n in os.listdir(target) if n != "manifest.json"
+    )
+    os.remove(victim)
+    with pytest.raises(ValueError, match="Refusing to resume"):
+        manager.resume(target)
+
+
+def test_resume_restores_step_and_rng_stream(tmp_path):
+    from accelerate_tpu.utils.random import next_rng_key, set_seed
+
+    acc, model, opt = _make_acc()
+    set_seed(11)
+    next_rng_key()  # advance the stream
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    manager.save(step=4, epoch=1)
+    expected_next = np.asarray(jax.random.key_data(next_rng_key()))
+
+    next_rng_key()  # diverge
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2, model2, opt2 = _make_acc()
+    manager2 = CheckpointManager(acc2, checkpoint_dir=str(tmp_path), handle_signals=())
+    resume = manager2.resume("auto")
+    assert resume.step == 4 and resume.epoch == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(next_rng_key())), expected_next
+    )
+
+
+def test_save_state_atomic_false_keeps_legacy_behavior(tmp_path):
+    acc, model, opt = _make_acc()
+    acc.save_state(str(tmp_path / "ckpt"), atomic=False)
+    assert (tmp_path / "ckpt").exists()
+    assert not (tmp_path / "ckpt" / "manifest.json").exists()
+    # atomic default writes the manifest
+    acc.save_state(str(tmp_path / "ckpt2"))
+    assert (tmp_path / "ckpt2" / "manifest.json").exists()
+    assert verify_checkpoint(str(tmp_path / "ckpt2")) == []
+
+
+def test_atomic_resave_same_dir_swaps_cleanly(tmp_path):
+    acc, model, opt = _make_acc()
+    batch = jnp.ones((4, 8), jnp.float32)
+    acc.save_state(str(tmp_path / "ckpt"))
+    acc.backward(_loss, batch)
+    opt.step()
+    opt.zero_grad()
+    newer = jax.device_get(model.params)
+    acc.save_state(str(tmp_path / "ckpt"))
+    assert verify_checkpoint(str(tmp_path / "ckpt")) == []
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    acc.load_state(str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(model.params)["w"]), np.asarray(newer["w"])
+    )
+
+
+def test_automatic_naming_rotation_happens_after_commit(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+        )
+    )
+    acc.prepare(Tiny())
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    for _ in range(3):
+        acc.save_state()
+    kept = list_checkpoints(str(tmp_path / "checkpoints"))
+    assert [os.path.basename(p) for p in kept] == ["checkpoint_1", "checkpoint_2"]
+    for path in kept:
+        assert verify_checkpoint(path) == []
+
+
+def test_manifest_metadata_records_dataloader_positions(tmp_path):
+    acc, model, opt = _make_acc()
+    data = [{"x": np.arange(8, dtype=np.float32) + i} for i in range(32)]
+    loader = acc.prepare_data_loader(data, batch_size=8, shuffle=True, seed=5)
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    loader.set_epoch(2)
+    it = iter(loader)
+    next(it)
+    next(it)
+    manager.save(step=12, epoch=2)
+    meta = read_manifest(str(tmp_path / "checkpoint_12"))["metadata"]
+    assert meta["dataloaders"] == [{"epoch": 2, "position": 2}]
+    assert meta["sharded"] is False
+
+
+def test_positions_track_live_loader_after_resumed_epoch(tmp_path):
+    """A save in the epoch AFTER a mid-epoch resume must record the live
+    loader's epoch/position, not the resumed epoch's skip-wrapper."""
+    from accelerate_tpu.fault_tolerance import ResumePoint
+
+    acc, model, opt = _make_acc()
+    data = [{"x": np.arange(8, dtype=np.float32) + i} for i in range(32)]
+    loader = acc.prepare_data_loader(data, batch_size=8, shuffle=True, seed=5)
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+    resume = ResumePoint(path="x", step=2, epoch=0, dataloaders=[{"epoch": 0, "position": 2}])
+
+    # resumed epoch 0: wrapper in place, positions absolute
+    loader.set_epoch(0)
+    epoch_loader = manager.resumed_loader(loader, resume, epoch=0)
+    assert epoch_loader is not loader
+    list(epoch_loader)  # finish the epoch (2 remaining batches)
+    manager.save(step=4, epoch=0)
+    meta = read_manifest(str(tmp_path / "checkpoint_4"))["metadata"]
+    assert meta["dataloaders"] == [{"epoch": 0, "position": 4}]
+
+    # epoch 1: the canonical loop calls resumed_loader again — wrapper undone
+    loader.set_epoch(1)
+    epoch_loader = manager.resumed_loader(loader, resume, epoch=1)
+    assert epoch_loader is loader
+    it = iter(epoch_loader)
+    next(it)
+    manager.save(step=5, epoch=1)
+    meta = read_manifest(str(tmp_path / "checkpoint_5"))["metadata"]
+    assert meta["dataloaders"] == [{"epoch": 1, "position": 1}]
+
+
+def test_accelerator_factory_and_save_on_preemption(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = acc.checkpoint_manager(str(tmp_path), save_interval=10, handle_signals=())
+    assert isinstance(manager, CheckpointManager)
+    assert manager.save_on_preemption(step=3) is False  # nothing pending: no save
+    assert list_checkpoints(str(tmp_path)) == []
+    manager.request_preemption()
+    assert manager.save_on_preemption(step=3) is True
+    assert [os.path.basename(p) for p in list_checkpoints(str(tmp_path))] == ["checkpoint_3"]
+    assert manager.save_on_preemption(step=4) is True  # idempotent: still one save
+    assert len(list_checkpoints(str(tmp_path))) == 1
+
+
+def test_manager_rejects_automatic_checkpoint_naming(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        )
+    )
+    with pytest.raises(ValueError, match="automatic_checkpoint_naming"):
+        CheckpointManager(acc, checkpoint_dir=str(tmp_path), handle_signals=())
+
+
+def test_preemption_sync_every_gates_the_collective_check(tmp_path):
+    acc, model, opt = _make_acc()
+    manager = CheckpointManager(
+        acc, checkpoint_dir=str(tmp_path), handle_signals=(), preemption_sync_every=4
+    )
+    manager.request_preemption()
+    # only steps on the sync cadence may consult (and act on) the flag —
+    # every host evaluates the same gate, keeping the collective aligned
+    assert not manager.should_save(3)
+    assert not manager.should_save(5)
+    assert manager.should_save(4)
+    assert manager.should_save(8)
+
+
+def test_load_state_auto_with_and_without_checksums(tmp_path):
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    acc.prepare(Tiny())
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    manager = CheckpointManager(acc, handle_signals=())
+    assert manager.checkpoint_dir == os.path.join(str(tmp_path), "checkpoints")
+    manager.save(step=2)
+    acc.load_state("auto")
+    acc.load_state("auto", check_checksums=False)
+    with pytest.raises(FileNotFoundError, match="auto"):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc2 = Accelerator(project_dir=str(tmp_path / "empty"))
+        acc2.prepare(Tiny())
+        acc2.load_state("auto")
+
+
+def test_any_process_single_host():
+    state = PartialState()
+    assert state.any_process(True) is True
+    assert state.any_process(False) is False

@@ -96,6 +96,45 @@ def test_tpu_config_cli_debug_prints(capsys):
     assert "gcloud compute tpus tpu-vm ssh p" in out
 
 
+def test_verify_checkpoint_cli_ok_and_fail(tmp_path, capsys):
+    """`accelerate-tpu verify-checkpoint <dir>` validates a manifest offline:
+    exit 0 on a complete checkpoint, 1 (with the problems listed) after
+    corruption."""
+    from accelerate_tpu.fault_tolerance import build_manifest, write_manifest
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    ckpt = tmp_path / "checkpoint_5"
+    ckpt.mkdir()
+    (ckpt / "model_0.npz").write_bytes(b"x" * 1024)
+    write_manifest(str(ckpt), build_manifest(str(ckpt), step=5))
+
+    assert cli_main(["verify-checkpoint", str(ckpt)]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out and "step 5" in out
+
+    (ckpt / "model_0.npz").write_bytes(b"y" * 512)  # corrupt after commit
+    assert cli_main(["verify-checkpoint", str(ckpt)]) == 1
+    err = capsys.readouterr().err
+    assert "size mismatch" in err
+
+    assert cli_main(["verify-checkpoint", str(tmp_path / "missing")]) == 1
+
+
+def test_verify_checkpoint_cli_no_checksums(tmp_path, capsys):
+    from accelerate_tpu.fault_tolerance import build_manifest, write_manifest
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    ckpt = tmp_path / "checkpoint_1"
+    ckpt.mkdir()
+    (ckpt / "w.bin").write_bytes(b"a" * 64)
+    write_manifest(str(ckpt), build_manifest(str(ckpt)))
+    (ckpt / "w.bin").write_bytes(b"b" * 64)  # same size, different bytes
+    assert cli_main(["verify-checkpoint", "--no-checksums", str(ckpt)]) == 0
+    assert cli_main(["verify-checkpoint", str(ckpt)]) == 1
+
+
 def test_notebook_launcher_runs_inline():
     from accelerate_tpu import notebook_launcher
 

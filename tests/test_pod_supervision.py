@@ -106,3 +106,77 @@ def test_supervision_flags_require_num_workers():
     )
     with pytest.raises(ValueError, match="num_workers"):
         run(args)
+
+
+def test_auto_resume_requires_num_workers():
+    import argparse
+
+    from accelerate_tpu.commands.pod import run
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, num_workers=None, restart_on_failure=0,
+        heartbeat_timeout=0.0, auto_resume=True, training_script="train.py",
+        training_script_args=[],
+    )
+    with pytest.raises(ValueError, match="num_workers"):
+        run(args)
+
+
+def test_auto_resume_requires_restarts():
+    """--auto_resume without --restart_on_failure would silently never
+    resume (the job dies on first failure) — reject loudly instead."""
+    import argparse
+
+    from accelerate_tpu.commands.pod import run
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, num_workers=2, restart_on_failure=0,
+        heartbeat_timeout=0.0, auto_resume=True, training_script="train.py",
+        training_script_args=[],
+    )
+    with pytest.raises(ValueError, match="restart_on_failure"):
+        run(args)
+
+
+def test_assemble_worker_command_resume_appends_flag():
+    import argparse
+
+    from accelerate_tpu.commands.pod import assemble_worker_command
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, training_script="train.py",
+        training_script_args=["--epochs", "3"],
+    )
+    plain = assemble_worker_command(args)
+    resumed = assemble_worker_command(args, resume=True)
+    assert plain.endswith("train.py --epochs 3")
+    assert resumed.endswith("train.py --epochs 3 --resume auto")
+
+
+def test_supervise_passes_attempt_to_two_arg_spawn():
+    """Relaunch attempts see attempt numbers (the auto-resume hook): the first
+    attempt fails, the second — which a real spawn would launch with
+    `--resume auto` — succeeds."""
+    attempts = []
+
+    def spawn(i, attempt):
+        attempts.append((i, attempt))
+        code = 5 if attempt == 1 else 0
+        return subprocess.Popen(
+            [sys.executable, "-c", f"import sys; sys.exit({code})"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    assert supervise(spawn, 1, restarts=1, poll_interval=0.05) == 0
+    assert attempts == [(0, 1), (0, 2)]
+
+
+def test_supervise_single_arg_spawn_still_works():
+    spawn = _spawn_script(["print('legacy')"])
+    assert supervise(spawn, 1, poll_interval=0.05) == 0
