@@ -46,6 +46,7 @@ from .parallel.sharding import PartitionRules, infer_shardings, replicated, shar
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .state import distributed_is_initialized as _distributed_is_initialized
+from .telemetry import Telemetry, TelemetryConfig
 from .utils.dataclasses import (
     CompilationConfig,
     FP8RecipeKwargs,
@@ -74,6 +75,16 @@ class ParamBox:
 
     def __init__(self, value: Any):
         self.value = value
+
+
+class ProfileCapture(str):
+    """What ``Accelerator.profile()`` yields: the log dir (it IS a str, so
+    existing ``os.walk(capture)`` call sites keep working) plus per-device
+    memory snapshots bracketing the trace — the cheapest answer to "did the
+    profiled region leak/spike HBM?" without opening the trace."""
+
+    memory_before: list = []
+    memory_after: list = []
 
 
 class PreparedModel:
@@ -150,6 +161,7 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         log_with: Optional[list] = None,
         kwargs_handlers: Optional[list[KwargsHandler]] = None,
+        telemetry_config: Optional[TelemetryConfig] = None,
     ):
         # -- plugin / parallelism resolution (reference accelerator.py:285-335)
         if model_parallel_plugin is not None and parallelism is None:
@@ -272,6 +284,30 @@ class Accelerator:
         self._load_model_hooks: list = []
 
         self.flag_tensor = None
+
+        # -- telemetry hub (telemetry/hub.py): step timing, compile capture,
+        # memory watermarks, goodput, profiler windows. Constructed here so
+        # compiles during prepare() are already attributed; near-zero cost
+        # until the user calls telemetry.step()/flush().
+        self.telemetry = Telemetry(accelerator=self, config=telemetry_config)
+        self._profile_active = False
+        if self.telemetry.enabled:
+            import weakref
+
+            from . import data_loader as _dl
+
+            # weakly bound: the module-level hook (last Accelerator wins)
+            # must not pin a dead Accelerator's goodput ledger for the
+            # process lifetime — same lifecycle rule as the compile
+            # tracker's weak-set dispatcher
+            goodput_ref = weakref.ref(self.telemetry.goodput)
+
+            def _record_rewind(seconds: float, batches: int) -> None:
+                goodput = goodput_ref()
+                if goodput is not None:
+                    goodput.record("dataloader_rewind", seconds)
+
+            _dl.rewind_seconds_hook = _record_rewind
 
     # ------------------------------------------------------------------
     # topology passthrough (reference properties)
@@ -547,6 +583,7 @@ class Accelerator:
             opt_reference_shardings=opt_reference_shardings,
             cpu_offload=cpu_offload,
         )
+        optimizer.telemetry = self.telemetry if self.telemetry.enabled else None
         self._optimizers.append(optimizer)
         return optimizer
 
@@ -818,28 +855,90 @@ class Accelerator:
         yield
 
     @contextmanager
-    def profile(self, log_dir: Optional[str] = None):
+    def profile(
+        self,
+        log_dir: Optional[str] = None,
+        port: Optional[int] = None,
+        host_metadata: Optional[dict] = None,
+    ):
         """Capture a ``jax.profiler`` device trace for the enclosed steps
         (SURVEY §5.1: the reference has only Megatron timers; XLA gives full
         timeline traces). View with TensorBoard or Perfetto::
 
-            with accelerator.profile("/tmp/trace"):
+            with accelerator.profile("/tmp/trace") as capture:
                 for batch in loader:
                     step(batch)
+            print(capture.memory_after)
+
+        ``port`` additionally starts the jax profiler server (for live
+        ``tensorboard --logdir`` capture against a running job); the server is
+        stopped on exit. ``host_metadata`` (plus process/device coordinates)
+        is written to ``host_metadata.json`` next to the trace so pod-wide
+        trace collections stay attributable. Yields a :class:`ProfileCapture`
+        (a ``str`` of the log dir, with per-device memory snapshots taken on
+        entry and exit as attributes). Not reentrant: nesting would interleave
+        two traces into one corrupt capture, so it raises instead.
         """
+        if self._profile_active:
+            raise RuntimeError(
+                "accelerator.profile() is already active — jax supports one "
+                "trace at a time, and nesting would corrupt the capture. "
+                "Close the outer profile() first."
+            )
+        from .utils.environment import get_device_memory_info
+        from .telemetry.step_timer import drain_local_devices
+
         if log_dir is None:
             log_dir = os.path.join(self.project_configuration.logging_dir or ".", "profile")
-        jax.profiler.start_trace(log_dir)
+        capture = ProfileCapture(log_dir)
+        capture.memory_before = get_device_memory_info()
+        server_started = False
+        if port is not None:
+            try:
+                jax.profiler.start_server(port)
+                server_started = True
+            except Exception as e:  # port in use / older jax: trace still works
+                logger.warning(f"profile(): could not start profiler server on port {port}: {e}")
+        os.makedirs(log_dir, exist_ok=True)
+        meta = {
+            "process_index": self.process_index,
+            "local_process_index": self.local_process_index,
+            "num_processes": self.num_processes,
+            "device_kind": getattr(jax.local_devices()[0], "device_kind", None),
+            **(host_metadata or {}),
+        }
         try:
-            yield log_dir
+            import json as _json
+
+            with open(os.path.join(log_dir, "host_metadata.json"), "w") as f:
+                _json.dump(meta, f, indent=2, default=str)
+        except OSError:
+            pass  # metadata is best-effort; the trace is the payload
+        jax.profiler.start_trace(log_dir)
+        # the guard flips only once the trace is live: a failed start_trace
+        # must not leave the accelerator permanently "profiling"
+        self._profile_active = True
+        try:
+            yield capture
         finally:
-            # drain async dispatch on EVERY device so the trace covers the
-            # final step's work on the whole mesh, not just device 0
-            for device in jax.local_devices():
-                # the +1 is a compute op: it queues behind in-flight programs
-                # on that device's stream (a bare transfer rides DMA instead)
-                (jax.device_put(0.0, device) + 1).block_until_ready()
-            jax.profiler.stop_trace()
+            try:
+                # drain async dispatch on EVERY device so the trace covers the
+                # final step's work on the whole mesh, not just device 0
+                drain_local_devices()
+                jax.profiler.stop_trace()
+                if server_started:
+                    try:
+                        jax.profiler.stop_server()
+                    except Exception:
+                        pass
+            finally:
+                # release the guard even when the stop path raises (full disk
+                # under the trace dir, wedged device): a failed stop must not
+                # leave the accelerator permanently "profiling"
+                capture.memory_after = get_device_memory_info()
+                self._profile_active = False
+                # a trace is non-step overhead; keep step-time samples honest
+                self.telemetry.timer.discard_window()
 
     # ------------------------------------------------------------------
     # fused fast path
@@ -946,6 +1045,8 @@ class Accelerator:
             # sees overflow-skipped steps exactly as on the eager path
             optimizer._skipped = skipped
             optimizer._step_count += 1
+            if optimizer.telemetry is not None:
+                optimizer.telemetry._on_optimizer_step()
             return loss
 
         return step
@@ -1094,6 +1195,10 @@ class Accelerator:
                 tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 
     def end_training(self) -> None:
+        # telemetry first: its final flush fans out through the trackers
+        # below. Collective when multi-host (like this method generally:
+        # call end_training on every process).
+        self.telemetry.finish()
         for tracker in self.trackers:
             tracker.finish()
 

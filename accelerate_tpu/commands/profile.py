@@ -1,0 +1,85 @@
+"""`accelerate-tpu profile` — windowed jax.profiler capture around a training
+command.
+
+Arms a step-aligned trace window via the ``ACCELERATE_PROFILE_*`` env vars
+(consumed by the Telemetry hub every ``telemetry.step()``) and launches the
+training script exactly like ``accelerate-tpu launch`` — same topology env
+plumbing, so the two compose with configs and pods:
+
+    accelerate-tpu profile --output-dir traces --start-step 100 --num-steps 20 \
+        train.py --epochs 1
+
+On a pod, run the same command on every host (or pass the env vars through
+``pod-launch``): each host starts its trace at the SAME step number, so the
+per-host timelines under ``<output-dir>/host_<i>`` line up by step rather
+than by wall clock — which is what makes cross-host comparison meaningful on
+a fleet with stragglers. ``--port`` additionally starts the live profiler
+server inside the job for on-demand TensorBoard capture.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "profile",
+        help="Run a training script with a step-aligned jax.profiler trace window",
+    )
+    parser.add_argument("--output-dir", required=True, help="Where per-host traces land")
+    parser.add_argument("--start-step", type=int, default=0, help="Step the trace starts at")
+    parser.add_argument("--num-steps", type=int, default=5, help="How many steps to capture")
+    parser.add_argument("--port", type=int, default=None, help="Also start the live profiler server on this port")
+    # the launch-compatible topology surface (pass-through to the same env)
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
+    parser.add_argument("--coordinator_address", default=None)
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "fp16", "bf16", "fp8"])
+    parser.add_argument("-m", "--module", action="store_true", help="Treat script as a python module")
+    parser.add_argument("training_script", help="Script (or module) to run under the profiler window")
+    parser.add_argument("training_script_args", nargs=_remainder())
+    parser.set_defaults(func=run)
+    return parser
+
+
+def _remainder():
+    import argparse
+
+    return argparse.REMAINDER
+
+
+def build_env(args) -> dict[str, str]:
+    env = dict(os.environ)
+    env["ACCELERATE_PROFILE_DIR"] = os.path.abspath(args.output_dir)
+    env["ACCELERATE_PROFILE_START_STEP"] = str(args.start_step)
+    env["ACCELERATE_PROFILE_STEPS"] = str(args.num_steps)
+    if args.port is not None:
+        env["ACCELERATE_PROFILE_PORT"] = str(args.port)
+    env["ACCELERATE_TELEMETRY"] = "1"  # the window rides the telemetry hub
+
+    def put(key: str, value) -> None:
+        if value is not None:
+            env[key] = str(value)
+
+    put("ACCELERATE_NUM_PROCESSES", args.num_processes)
+    put("ACCELERATE_PROCESS_ID", args.process_id)
+    put("ACCELERATE_COORDINATOR_ADDRESS", args.coordinator_address)
+    put("ACCELERATE_MIXED_PRECISION", args.mixed_precision)
+    return env
+
+
+def run(args) -> int:
+    env = build_env(args)
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m", args.training_script]
+    else:
+        cmd += [args.training_script]
+    cmd += args.training_script_args
+    completed = subprocess.run(cmd, env=env)
+    if completed.returncode == 0:
+        print(f"Profiler traces (one dir per host) under: {env['ACCELERATE_PROFILE_DIR']}")
+    return completed.returncode

@@ -24,6 +24,7 @@ the step, no per-rank choreography.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
@@ -787,6 +788,23 @@ class SkipBatchSampler:
                 yield batch
 
 
+# Telemetry seam: called as ``hook(seconds, batches_skipped)`` when a
+# SkipDataLoader finishes replaying consumed batches — the dataloader-rewind
+# cost of a mid-epoch resume. (The DataLoaderShard path skips at the
+# batch-SAMPLER level, which costs nothing and reports nothing.) The
+# Telemetry hub installs this; it must never raise into the data path.
+rewind_seconds_hook: "Optional[Callable[[float, int], None]]" = None
+
+
+def _fire_rewind(seconds: float, batches: int) -> None:
+    hook = rewind_seconds_hook
+    if hook is not None:
+        try:
+            hook(seconds, batches)
+        except Exception:
+            pass
+
+
 class SkipDataLoader(BaseDataLoader):
     """Iterable-loader variant of batch skipping (data_loader.py:1026)."""
 
@@ -802,8 +820,14 @@ class SkipDataLoader(BaseDataLoader):
 
     def __iter__(self):
         self.batches_yielded = 0
+        rewind_start = time.perf_counter() if self.skip_batches else None
         for i, batch in enumerate(self.inner_loader):
             if i >= self.skip_batches:
+                if rewind_start is not None:
+                    # the replayed batches are pure resume overhead — surface
+                    # them to the goodput ledger once, at the first real batch
+                    _fire_rewind(time.perf_counter() - rewind_start, self.skip_batches)
+                    rewind_start = None
                 self.batches_yielded += 1
                 yield batch
 

@@ -357,6 +357,18 @@ class CheckpointManager:
         if handle_signals:
             self._install_handlers(handle_signals)
 
+    def _telemetry_pause(self, category: str):
+        """Goodput bracket around save/restore: the elapsed time lands in the
+        accelerator's telemetry ledger (and the step-timer's in-flight window
+        is discarded so the stall never reads as a slow step). No-op when the
+        accelerator carries no telemetry hub."""
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        if telemetry is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        return telemetry.pause(category)
+
     # -- preemption --------------------------------------------------------
 
     def _install_handlers(self, signals_to_handle) -> None:
@@ -478,7 +490,8 @@ class CheckpointManager:
         save = self.accelerator.save_state
         if state.num_processes == 1:
             save = retry_transient_io(save)
-        save(target, sharded=self.sharded, manifest_metadata=meta)
+        with self._telemetry_pause("checkpoint_save"):
+            save(target, sharded=self.sharded, manifest_metadata=meta)
         # collective check, not the host-local flag: the signal landed on one
         # host, but EVERY host must flip exit_requested or the others keep
         # looping into a deadlocked barrier
@@ -542,7 +555,13 @@ class CheckpointManager:
         load = self.accelerator.load_state
         if PartialState().num_processes == 1:
             load = retry_transient_io(load)
-        load(path)
+        with self._telemetry_pause("checkpoint_restore"):
+            load(path)
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        if telemetry is not None:
+            # a restore on THIS process means the run restarted (or was
+            # explicitly rewound) — either way the goodput ledger records it
+            telemetry.goodput.mark_restart()
         manifest = read_manifest(path) or {}
         meta = manifest.get("metadata", {})
         point = ResumePoint(

@@ -14,6 +14,9 @@ import os
 class MultiProcessAdapter(logging.LoggerAdapter):
     """Logs only on the main process unless ``main_process_only=False``.
 
+    Every record is stamped with ``process_index``/``local_process_index``
+    so multi-host telemetry logs stay attributable once they are interleaved
+    in a shared sink (format with ``%(process_index)s`` to surface them).
     ``in_order=True`` emits from each process in process-index order (each host
     waits for the ones before it) — useful for debugging per-host state.
     """
@@ -23,6 +26,20 @@ class MultiProcessAdapter(logging.LoggerAdapter):
         from .state import PartialState
 
         return not main_process_only or PartialState().is_main_process
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        try:
+            from .state import PartialState
+
+            state = PartialState()
+            extra.setdefault("process_index", state.process_index)
+            extra.setdefault("local_process_index", state.local_process_index)
+        except Exception:
+            # logging must work even before/without topology bootstrap
+            extra.setdefault("process_index", 0)
+            extra.setdefault("local_process_index", 0)
+        return msg, kwargs
 
     def log(self, level, msg, *args, **kwargs):
         from .state import PartialState

@@ -259,6 +259,22 @@ def list_models() -> list[str]:
     return sorted(_REGISTRY)
 
 
+def train_flops_per_token(config: TransformerConfig, seq_len: int | None = None) -> float:
+    """Training FLOPs per token: the standard 6·N dense estimate (fwd + bwd)
+    plus 12·L·H·S for the self-attention score/context matmuls, which the
+    parameter count does not see. Shared by MFU derivation in telemetry and
+    the benchmark suite so the two can never disagree."""
+    seq = seq_len if seq_len is not None else config.max_seq_len
+    dense = 6.0 * param_count(config)
+    attention = 12.0 * config.num_layers * config.hidden_size * seq
+    return dense + attention
+
+
+def train_flops_per_step(config: TransformerConfig, batch_size: int, seq_len: int) -> float:
+    """Training FLOPs for one optimizer step over ``batch_size`` sequences."""
+    return batch_size * seq_len * train_flops_per_token(config, seq_len)
+
+
 def param_count(config: TransformerConfig) -> int:
     """Exact parameter count without materializing anything."""
     h, i, v = config.hidden_size, config.intermediate_size, config.vocab_size

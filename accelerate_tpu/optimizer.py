@@ -147,6 +147,9 @@ class AcceleratedOptimizer:
         self._grads = None  # accumulated (sum) grads, lazily allocated
         self._accum_count = 0
         self._step_count = 0
+        # telemetry seam (set by Accelerator.prepare_optimizer): counts real
+        # optimizer steps without forcing any device sync on the hot path
+        self.telemetry = None
         self._skipped = jnp.asarray(False)
         if scaler is not None:
             rep = replicated(mesh)
@@ -261,6 +264,8 @@ class AcceleratedOptimizer:
         self._grads = None
         self._accum_count = 0
         self._step_count += 1
+        if self.telemetry is not None:
+            self.telemetry._on_optimizer_step()
 
     def zero_grad(self, set_to_none: bool = True) -> None:  # noqa: ARG002 - parity
         if self.gradient_state.sync_gradients:

@@ -221,6 +221,37 @@ class PartialState:
         votes = multihost_utils.process_allgather(np.asarray([1 if flag else 0], np.int32))
         return bool(np.asarray(votes).sum() > 0)
 
+    def aggregate_metrics(self, metrics: "dict[str, Any]") -> "dict[str, dict[str, float]]":
+        """min/max/mean of each numeric metric across hosts.
+
+        The telemetry flush primitive: per-host scalars (step time, HBM
+        watermark, goodput) become fleet-wide spreads — a straggler shows up
+        as max ≫ mean, a leaking host as an HBM max outlier. COLLECTIVE when
+        ``num_processes > 1`` (one ``gather_object`` round): every host must
+        call it at the same point. Non-numeric entries are dropped; hosts may
+        carry different key sets (union semantics, like missing samples).
+        """
+        numeric = {
+            k: float(v)
+            for k, v in metrics.items()
+            if isinstance(v, (int, float, np.integer, np.floating)) and not isinstance(v, bool)
+        }
+        if self.num_processes == 1:
+            return {k: {"min": v, "max": v, "mean": v} for k, v in numeric.items()}
+        from .ops.operations import gather_object
+
+        rows = gather_object([numeric])
+        keys = sorted({k for row in rows for k in row})
+        out = {}
+        for key in keys:
+            values = [row[key] for row in rows if key in row]
+            out[key] = {
+                "min": min(values),
+                "max": max(values),
+                "mean": sum(values) / len(values),
+            }
+        return out
+
     @contextmanager
     def main_process_first(self):
         """Main host runs the body first, the rest afterwards (state.py:484)."""

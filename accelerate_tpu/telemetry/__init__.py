@@ -1,0 +1,28 @@
+"""Telemetry subsystem: step-time/goodput metrics, compile tracking, memory
+watermarks, throughput/MFU derivation, and profiler orchestration.
+
+Entry point is the :class:`Telemetry` hub hanging off every ``Accelerator``
+(``accelerator.telemetry``); the pieces are usable standalone too. See
+docs/observability.md for the metrics glossary and the telemetry.jsonl schema.
+"""
+
+from .compile_tracker import CompileTracker
+from .flops import PEAK_BF16_FLOPS, device_peak_flops
+from .goodput import GoodputTracker
+from .hub import Telemetry, TelemetryConfig
+from .memory import MemoryMonitor
+from .profiler import ProfileWindow
+from .step_timer import StepTimer, drain_local_devices
+
+__all__ = [
+    "CompileTracker",
+    "GoodputTracker",
+    "MemoryMonitor",
+    "PEAK_BF16_FLOPS",
+    "ProfileWindow",
+    "StepTimer",
+    "Telemetry",
+    "TelemetryConfig",
+    "device_peak_flops",
+    "drain_local_devices",
+]

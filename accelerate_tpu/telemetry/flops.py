@@ -1,0 +1,37 @@
+"""Hardware peak-FLOPs lookup for MFU derivation.
+
+The model-side FLOPs estimate lives in ``models/config.py``
+(``train_flops_per_step``); this module owns the hardware side — peak dense
+bf16 matmul throughput per chip. Sources: public TPU spec sheets;
+``fallback_tpu`` covers unknown TPU generations conservatively. ``bench.py``
+and the telemetry hub both read THIS table so a benchmark and a live run can
+never disagree about what "MFU 0.4" means.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "fallback_tpu": 197e12,
+}
+
+
+def device_peak_flops() -> Optional[float]:
+    """Peak bf16 FLOPs/sec of one local device, or None when the backend has
+    no meaningful peak (CPU — MFU would be noise, not signal)."""
+    import jax
+
+    device = jax.devices()[0]
+    if device.platform != "tpu":
+        return None
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return flops
+    return PEAK_BF16_FLOPS["fallback_tpu"]

@@ -1,0 +1,308 @@
+"""The Telemetry hub: one object wiring timers, compile capture, memory
+watermarks, goodput, throughput/MFU derivation, and the profiler window into
+an Accelerator, with multi-host aggregation and a machine-readable sink.
+
+Canonical loop::
+
+    accelerator = Accelerator()                      # hub comes attached
+    telemetry = accelerator.telemetry
+    telemetry.configure_throughput(model.config, batch_size=32, seq_len=128)
+    for batch in loader:
+        loss = step(batch)
+        telemetry.step(loss)                         # fences only on cadence
+        if telemetry.should_flush():
+            telemetry.flush(step=telemetry.steps)    # collective on pods
+    telemetry.finish()
+
+Steady-state cost: ``step()`` outside a sampling boundary is a few integer
+compares — no host sync, no device fence, no allocation. ``flush()`` IS a
+collective when ``num_processes > 1`` (it aggregates min/max/mean across
+hosts), so every host must call it at the same step — same contract as
+``save_state``. Records land in ``telemetry.jsonl`` (main process) and fan
+out to any active ``tracking.py`` trackers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..logging import get_logger
+from ..utils.environment import parse_flag_from_env, parse_int_from_env
+from .compile_tracker import CompileTracker
+from .goodput import GoodputTracker
+from .memory import MemoryMonitor
+from .profiler import ProfileWindow
+from .step_timer import StepTimer
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class TelemetryConfig:
+    enabled: bool = True
+    sample_every: int = 16      # steps between forced fences (and memory polls)
+    flush_every: int = 0        # steps between automatic flushes; 0 = manual only
+    dir: Optional[str] = None   # telemetry.jsonl directory (default: logging_dir)
+    track_compiles: bool = True
+    peak_flops_per_device: Optional[float] = None  # override for MFU (None = probe)
+
+    @classmethod
+    def from_env(cls) -> "TelemetryConfig":
+        return cls(
+            enabled=parse_flag_from_env("ACCELERATE_TELEMETRY", True),
+            sample_every=parse_int_from_env("ACCELERATE_TELEMETRY_SAMPLE_EVERY", 16),
+            flush_every=parse_int_from_env("ACCELERATE_TELEMETRY_FLUSH_EVERY", 0),
+            dir=os.environ.get("ACCELERATE_TELEMETRY_DIR"),
+        )
+
+
+class Telemetry:
+    def __init__(self, accelerator: Any = None, config: Optional[TelemetryConfig] = None):
+        self.accelerator = accelerator
+        self.config = config or TelemetryConfig.from_env()
+        self.enabled = self.config.enabled
+        self.timer = StepTimer(sample_every=self.config.sample_every)
+        self.compiles = CompileTracker()
+        self.memory = MemoryMonitor()
+        self.goodput = GoodputTracker()
+        self.profile_window = ProfileWindow.from_env() if self.enabled else None
+        self._created = time.perf_counter()
+        self._first_step_done = False
+        self.optimizer_steps = 0
+        self._file = None
+        self._finished = False
+        self._last_flush_step: Optional[int] = None
+        self._throughput: dict[str, float] = {}
+        if self.enabled and self.config.track_compiles:
+            self.compiles.start()
+
+    # -- configuration -----------------------------------------------------
+
+    def configure_throughput(
+        self,
+        model_config: Any = None,
+        batch_size: Optional[int] = None,
+        seq_len: Optional[int] = None,
+        flops_per_step: Optional[float] = None,
+        tokens_per_step: Optional[int] = None,
+        examples_per_step: Optional[int] = None,
+        peak_flops_per_device: Optional[float] = None,
+    ) -> None:
+        """Teach the hub what one step computes so flush can derive
+        tokens/sec, examples/sec, and MFU. Either pass a zoo
+        ``TransformerConfig`` (+ batch/seq) for the built-in FLOPs estimator,
+        or raw ``flops_per_step``/``tokens_per_step`` for custom models.
+        ``batch_size``/``seq_len`` are GLOBAL (whole-job) sizes."""
+        if model_config is not None and batch_size is not None and seq_len is not None:
+            from ..models.config import train_flops_per_step
+
+            flops_per_step = train_flops_per_step(model_config, batch_size, seq_len)
+            tokens_per_step = tokens_per_step or batch_size * seq_len
+            examples_per_step = examples_per_step or batch_size
+        if flops_per_step is not None:
+            self._throughput["flops_per_step"] = float(flops_per_step)
+        if tokens_per_step is not None:
+            self._throughput["tokens_per_step"] = float(tokens_per_step)
+        if examples_per_step is not None:
+            self._throughput["examples_per_step"] = float(examples_per_step)
+        if peak_flops_per_device is not None:
+            self.config.peak_flops_per_device = float(peak_flops_per_device)
+
+    # -- per-step hot path -------------------------------------------------
+
+    @property
+    def steps(self) -> int:
+        return self.timer.steps
+
+    def step(self, outputs: Any = None) -> None:
+        """Record one completed training step. Pass the step's outputs (loss)
+        so sampling fences wait on real work instead of a marker op."""
+        if not self.enabled:
+            return
+        if not self._first_step_done:
+            self._first_step_done = True
+            # startup = process/hub creation → end of first step, minus the
+            # compile time monitoring already attributed (the goodput ledger
+            # counts compile separately; without the subtraction the first
+            # program's compile would be charged twice)
+            startup = time.perf_counter() - self._created - self.compiles.compile_seconds
+            self.goodput.record("startup", max(startup, 0.0))
+        if self.profile_window is not None:
+            self.profile_window.on_step(self.timer.steps)
+        self.timer.step(outputs)
+        if self.timer.steps % self.config.sample_every == 0:
+            self.memory.sample()
+        if self.config.flush_every and self.timer.steps % self.config.flush_every == 0:
+            self.flush(step=self.timer.steps)
+
+    def _on_optimizer_step(self) -> None:
+        self.optimizer_steps += 1
+
+    @contextmanager
+    def pause(self, category: str):
+        """Bracket non-step overhead (checkpoint save, manual eval, ...): the
+        elapsed time lands in the goodput ledger under ``category`` and the
+        step-timer's in-flight window is discarded so the stall never
+        pollutes the step-time distribution."""
+        if not self.enabled:
+            yield
+            return
+        try:
+            with self.goodput.timer(category):
+                yield
+        finally:
+            # even when the paused work raises: the stall must never leak
+            # into the step-time distribution (it is already in the ledger)
+            self.timer.discard_window()
+
+    def should_flush(self) -> bool:
+        """Whether the canonical loop should flush now. False when step()'s
+        auto-flush already emitted this boundary's record — the two patterns
+        compose without double-writing (or double-running the collective)."""
+        return bool(
+            self.enabled
+            and self.config.flush_every
+            and self.timer.steps % self.config.flush_every == 0
+            and self._last_flush_step != self.timer.steps
+        )
+
+    # -- derived metrics ---------------------------------------------------
+
+    def _peak_flops(self) -> Optional[float]:
+        if self.config.peak_flops_per_device is not None:
+            return self.config.peak_flops_per_device
+        from .flops import device_peak_flops
+
+        return device_peak_flops()
+
+    def metrics(self) -> dict:
+        """Flat scalar metrics — what aggregates across hosts and feeds the
+        trackers. Nested detail (per-device memory, per-event compiles) goes
+        in the jsonl record only."""
+        out: dict[str, Any] = dict(self.timer.summary())
+        mean = self.timer.mean_step_seconds
+        if mean and mean > 0:
+            steps_per_sec = 1.0 / mean
+            tokens = self._throughput.get("tokens_per_step")
+            if tokens:
+                out["tokens_per_sec"] = tokens * steps_per_sec
+            examples = self._throughput.get("examples_per_step")
+            if examples:
+                out["examples_per_sec"] = examples * steps_per_sec
+            flops = self._throughput.get("flops_per_step")
+            peak = self._peak_flops()
+            if flops and peak:
+                import jax
+
+                out["mfu"] = flops * steps_per_sec / (peak * jax.device_count())
+        compiles = self.compiles.snapshot()
+        out["compile_count"] = compiles["compile_count"]
+        out["compile_seconds"] = compiles["compile_seconds"]
+        out["jit_cache_hits"] = compiles["jit_cache_hits"]
+        out["jit_cache_misses"] = compiles["jit_cache_misses"]
+        hbm = self.memory.hbm_high_watermark_bytes
+        if hbm is not None:
+            out["hbm_high_watermark_bytes"] = hbm
+        host_peak = self.memory.snapshot().get("host_peak_rss_bytes")
+        if host_peak is not None:
+            out["host_peak_rss_bytes"] = host_peak
+        goodput = self.goodput.snapshot(self.timer.productive_seconds, compiles["compile_seconds"])
+        if goodput["goodput"] is not None:
+            out["goodput"] = goodput["goodput"]
+        out["optimizer_steps"] = self.optimizer_steps
+        return out
+
+    # -- flush / sinks -----------------------------------------------------
+
+    def flush(self, step: Optional[int] = None) -> Optional[dict]:
+        """Aggregate + emit one telemetry record. COLLECTIVE on multi-host
+        jobs (min/max/mean ride a host allgather): call it on every host at
+        the same step, like ``save_state``. Returns the record (every host)."""
+        if not self.enabled:
+            return None
+        from ..state import PartialState
+
+        state = PartialState()
+        self._last_flush_step = self.timer.steps
+        self.memory.sample()  # fresh watermark at the flush boundary
+        metrics = self.metrics()
+        compiles = self.compiles.snapshot()
+        goodput = self.goodput.snapshot(self.timer.productive_seconds, compiles["compile_seconds"])
+        record = {
+            "kind": "telemetry",
+            "step": self.timer.steps if step is None else step,
+            "time": time.time(),
+            "process_index": state.process_index,
+            "num_processes": state.num_processes,
+            "metrics": metrics,
+            "compiles": compiles,
+            "memory": self.memory.snapshot(),
+            "goodput": goodput,
+            "aggregate": state.aggregate_metrics(metrics),
+        }
+        if state.is_main_process:
+            self._write(record)
+            accelerator = self.accelerator
+            if accelerator is not None and getattr(accelerator, "trackers", None):
+                scalars = {
+                    f"telemetry/{k}": v
+                    for k, v in metrics.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)
+                }
+                accelerator.log(scalars, step=record["step"])
+        return record
+
+    def _sink_path(self) -> str:
+        directory = self.config.dir
+        if directory is None and self.accelerator is not None:
+            project = getattr(self.accelerator, "project_configuration", None)
+            directory = getattr(project, "logging_dir", None) or getattr(project, "project_dir", None)
+        directory = directory or "."
+        os.makedirs(directory, exist_ok=True)
+        return os.path.join(directory, "telemetry.jsonl")
+
+    def _write(self, record: dict) -> None:
+        from ..tracking import dumps_robust
+
+        if self._file is None:
+            self._file = open(self._sink_path(), "a")
+        self._file.write(dumps_robust(record) + "\n")
+        self._file.flush()
+
+    def finish(self, flush: bool = True) -> None:
+        """Final flush + release hooks. Collective when multi-host (the final
+        flush aggregates); idempotent — the second call (e.g. an explicit
+        finish() followed by end_training()) is a no-op, so it can never
+        append a duplicate record or run an unmatched collective."""
+        if not self.enabled or self._finished:
+            return
+        self._finished = True
+        if self.profile_window is not None:
+            self.profile_window.close()
+        if flush and self.timer.steps:
+            self.flush(step=self.timer.steps)
+        self.compiles.stop()
+        if self._file is not None:
+            try:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            except (OSError, ValueError):
+                pass
+            self._file.close()
+            self._file = None
+
+    def to_json(self) -> str:
+        from ..tracking import dumps_robust
+
+        return dumps_robust(self.metrics())
+
+    def __repr__(self) -> str:
+        return (
+            f"Telemetry(enabled={self.enabled}, steps={self.timer.steps}, "
+            f"sample_every={self.config.sample_every}, "
+            f"compiles={self.compiles.compile_count})"
+        )

@@ -202,6 +202,38 @@ def main():
         if state.is_main_process:
             shutil.rmtree(d2, ignore_errors=True)
 
+    # telemetry aggregation across REAL processes: per-host metric values
+    # must come back as fleet min/max/mean on EVERY host (the collective the
+    # hub's flush rides), and the flush itself must emit exactly one jsonl
+    # record — from the main process only.
+    agg = state.aggregate_metrics({"per_host": float(state.process_index), "same": 7.0})
+    n = state.num_processes
+    assert agg["per_host"] == {"min": 0.0, "max": float(n - 1), "mean": (n - 1) / 2}, agg
+    assert agg["same"]["min"] == agg["same"]["max"] == 7.0, agg
+
+    d3 = broadcast_object_list([tempfile.mkdtemp() if state.is_main_process else None])[0]
+    try:
+        from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+        telemetry = Telemetry(
+            accelerator=accelerator, config=TelemetryConfig(sample_every=2, dir=d3)
+        )
+        for _ in range(4):
+            loss = accelerator.backward(loss_fn, {"x": jnp.ones((4,)), "y": jnp.ones((4,))})
+            telemetry.step(loss)
+        record = telemetry.flush()  # collective: every host calls it
+        assert record["aggregate"]["steps"]["min"] == 4.0, record["aggregate"]["steps"]
+        telemetry.finish()
+        state.wait_for_everyone()
+        if state.is_main_process:
+            sink = os.path.join(d3, "telemetry.jsonl")
+            lines = [json.loads(l) for l in open(sink)]
+            assert lines and lines[0]["metrics"]["steps"] == 4, lines
+    finally:
+        state.wait_for_everyone()
+        if state.is_main_process:
+            shutil.rmtree(d3, ignore_errors=True)
+
     state.wait_for_everyone()
     state.print(json.dumps({"multiprocess_ok": True, "processes": state.num_processes, "devices": state.num_devices}))
 

@@ -27,6 +27,60 @@ from .utils.imports import (
 
 logger = get_logger(__name__)
 
+
+def json_default(obj: Any):
+    """``json.dumps(default=...)`` coercion for the values telemetry and
+    training loops actually log: jax/numpy scalars become numbers (not the
+    strings ``default=str`` produced), small arrays become lists, everything
+    else degrades to ``str`` so a sink never crashes a run."""
+    import numpy as np
+
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        try:
+            return obj.item()  # 0-d jax.Array and friends
+        except Exception:
+            pass
+    if hasattr(obj, "tolist"):
+        try:
+            return obj.tolist()  # small jax arrays
+        except Exception:
+            pass
+    if isinstance(obj, (set, frozenset)):
+        return sorted(str(v) for v in obj)
+    return str(obj)
+
+
+def coerce_jsonable(obj: Any) -> Any:
+    """Deep-coerce a tree into plain JSON types (keys stringified) — the
+    fallback for payloads ``json.dumps(default=json_default)`` still rejects,
+    e.g. tuple-keyed dicts or NaN-free encoders."""
+    if isinstance(obj, dict):
+        return {str(k): coerce_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [coerce_jsonable(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj
+    coerced = json_default(obj)
+    return coerce_jsonable(coerced) if isinstance(coerced, (dict, list, tuple)) else coerced
+
+
+def dumps_robust(record: Any) -> str:
+    """Serialize ``record`` without ever raising: numeric coercion first,
+    deep sanitization if the structure itself is unserializable."""
+    try:
+        return json.dumps(record, default=json_default)
+    except (TypeError, ValueError):
+        return json.dumps(coerce_jsonable(record), default=str)
+
+
 _available_trackers: dict[str, type] = {}
 
 
@@ -207,17 +261,29 @@ class JSONLTracker(GeneralTracker):
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
-        self._file.write(json.dumps({"_config": values, "_time": time.time()}, default=str) + "\n")
+        self._file.write(dumps_robust({"_config": values, "_time": time.time()}) + "\n")
         self._file.flush()
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        # dumps_robust: jax/numpy scalars land as numbers, and a weird value
+        # degrades to a string instead of crashing the run (telemetry sinks
+        # here every flush — a logging failure must never kill training)
         record = {**values, "_step": step, "_time": time.time()}
-        self._file.write(json.dumps(record, default=str) + "\n")
+        self._file.write(dumps_robust(record) + "\n")
         self._file.flush()
 
     @on_main_process
     def finish(self) -> None:
+        if self._file.closed:
+            return
+        # durability on preemption: the last flush must survive the VM dying
+        # right after the run exits (GCS-fuse/NFS lose unfsynced pages)
+        self._file.flush()
+        try:
+            os.fsync(self._file.fileno())
+        except OSError:
+            pass  # non-fsyncable sinks (pipes) still got the flush
         self._file.close()
 
 

@@ -87,7 +87,8 @@ def tpu_generation() -> str | None:
 
 
 def get_device_memory_info() -> list[dict[str, int]]:
-    """Per-device {bytes_limit, bytes_in_use} from jax memory_stats (empty on CPU)."""
+    """Per-device {bytes_limit, bytes_in_use, peak_bytes_in_use} from jax
+    memory_stats (empty on CPU / tunneled transports that expose none)."""
     import jax
 
     infos = []
@@ -98,9 +99,34 @@ def get_device_memory_info() -> list[dict[str, int]]:
                 {
                     "bytes_limit": int(stats.get("bytes_limit", 0)),
                     "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(
+                        stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+                    ),
                 }
             )
     return infos
+
+
+def get_host_memory_info() -> dict[str, int]:
+    """Host-process RSS {rss_bytes, peak_rss_bytes} via ``resource`` — the
+    memory watermark that exists on EVERY backend, including CPU runs where
+    ``memory_stats()`` is None (telemetry's fallback watermark source)."""
+    try:
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        scale = 1 if os.uname().sysname == "Darwin" else 1024
+        peak = int(usage.ru_maxrss) * scale
+    except Exception:
+        return {}
+    rss = peak
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    return {"rss_bytes": int(rss), "peak_rss_bytes": peak}
 
 
 def check_fp8_capability() -> bool:

@@ -10,7 +10,23 @@ reuse.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
+
+# Telemetry seam: when set, called as ``hook(event, key)`` with event "hit"
+# (a cached program was served) or "miss" (build() ran — a fresh trace, and
+# almost always a fresh XLA compile). telemetry.CompileTracker installs a
+# dispatcher here; the hook must never raise into the hot path, so callers
+# fire it through ``_fire_cache_event``.
+cache_event_hook: Optional[Callable[[str, Any], None]] = None
+
+
+def _fire_cache_event(event: str, key: Any) -> None:
+    hook = cache_event_hook
+    if hook is not None:
+        try:
+            hook(event, key)
+        except Exception:
+            pass  # observability must never take down the compute path
 
 
 def dot_keyed_jit(owner: Any, store_attr: str, key, build: Callable, dot_holder: Any = None):
@@ -24,5 +40,8 @@ def dot_keyed_jit(owner: Any, store_attr: str, key, build: Callable, dot_holder:
     dot_fn = getattr(dot_holder if dot_holder is not None else owner, "dot_fn", None)
     entry = store.get(key)
     if entry is None or entry[0] is not dot_fn:
+        _fire_cache_event("miss", key)
         store[key] = (dot_fn, build())
+    else:
+        _fire_cache_event("hit", key)
     return store[key][1]

@@ -87,39 +87,40 @@ _V5E_FLOORS = {
 }
 PERF_FLOORS = {"v5e": _V5E_FLOORS, "v5 lite": _V5E_FLOORS, "v5litepod": _V5E_FLOORS}
 
-# peak dense matmul throughput per chip, bf16 (for MFU). Sources: public TPU
-# spec sheets; "fallback" covers unknown TPU generations conservatively.
-PEAK_BF16_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-    "fallback_tpu": 197e12,
-}
-
-
 def _chip_peak_flops() -> float | None:
-    import jax
+    # single source of truth shared with the live-run MFU derivation
+    # (telemetry/flops.py) so a benchmark and a run can never disagree
+    from accelerate_tpu.telemetry.flops import device_peak_flops
 
-    device = jax.devices()[0]
-    if device.platform != "tpu":
-        return None  # MFU on CPU is meaningless
-    kind = getattr(device, "device_kind", "").lower()
-    for key, flops in PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return flops
-    return PEAK_BF16_FLOPS["fallback_tpu"]
+    return device_peak_flops()
 
 
 def _train_flops_per_step(config, batch: int, seq: int) -> float:
-    """Standard transformer training FLOPs: 6·N per token for the dense path
-    plus 12·L·H·S per token for self-attention score/context matmuls."""
-    from accelerate_tpu.models.config import param_count
+    """Standard transformer training FLOPs (6·N dense + 12·L·H·S attention
+    per token) — the estimator in models/config.py, shared with telemetry."""
+    from accelerate_tpu.models.config import train_flops_per_step
 
-    tokens = batch * seq
-    dense = 6.0 * param_count(config) * tokens
-    attention = 12.0 * config.num_layers * config.hidden_size * seq * tokens
-    return dense + attention
+    return train_flops_per_step(config, batch, seq)
+
+
+def _phase_telemetry(step, batch, prefix: str, n_steps: int = 24, sample_every: int = 4) -> dict:
+    """Per-phase step-time percentiles via the telemetry StepTimer (fences
+    only on the sampling cadence, so the distribution is the async-dispatch-
+    correct one). Runs AFTER the paired timing windows — the sampled pass
+    must never pollute the gated measurement. Gives future rounds a
+    per-phase trajectory with tail attribution, not just a mean."""
+    from accelerate_tpu.telemetry import StepTimer
+
+    timer = StepTimer(sample_every=sample_every)
+    for _ in range(n_steps):
+        loss = step(batch)
+        timer.step(loss)
+    out = {}
+    summary = timer.summary()
+    for key in ("step_time_mean_ms", "step_time_p50_ms", "step_time_p90_ms", "step_time_p99_ms"):
+        if key in summary:
+            out[f"{prefix}_{key}"] = round(summary[key], 3)
+    return out
 
 
 def _streaming_footprint(lm) -> tuple[int, int, int]:
@@ -258,7 +259,9 @@ def bench_bert_training() -> dict:
 
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import Bert
+    from accelerate_tpu.telemetry import CompileTracker
 
+    compiles = CompileTracker().start()
     accelerator = Accelerator(mixed_precision="bf16")
     model = Bert("bert-base")
     accelerator.prepare_model(model)
@@ -288,6 +291,12 @@ def bench_bert_training() -> dict:
     if peak is not None:
         flops = _train_flops_per_step(model.config, batch_size, seq_len)
         result["bert_train_mfu"] = round(flops * steps_per_sec_per_chip / peak, 4)
+
+    # per-phase tail attribution + compile accounting (after the gated windows)
+    result.update(_phase_telemetry(step, batch, "bert"))
+    compiles.stop()
+    result["bert_compile_count"] = compiles.compile_count
+    result["bert_compile_s"] = round(compiles.compile_seconds, 2)
 
     # profiler artifact of the primary section (VERDICT r5 #1a): a trace the
     # judge/next round can attribute step time with. AFTER the timed windows
@@ -336,8 +345,10 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
 
     from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, ParallelismConfig
     from accelerate_tpu.models import Llama
+    from accelerate_tpu.telemetry import CompileTracker
 
     _reset_state()
+    compiles = CompileTracker().start()
     accelerator = Accelerator(
         mixed_precision="bf16",
         parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
@@ -379,6 +390,10 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
     if peak is not None:
         flops = _train_flops_per_step(model.config, batch_size, seq_len)
         result[f"{prefix}_train_mfu"] = round(flops * steps_per_sec / (peak * jax.device_count()), 4)
+    result.update(_phase_telemetry(step, batch, prefix, n_steps=2 * n_steps, sample_every=max(n_steps // 4, 2)))
+    compiles.stop()
+    result[f"{prefix}_compile_count"] = compiles.compile_count
+    result[f"{prefix}_compile_s"] = round(compiles.compile_seconds, 2)
     return result
 
 

@@ -55,6 +55,24 @@ def test_checkpointing_example_resume(tmp_path):
     assert "saved epoch_1" in out
 
 
+def test_telemetry_example(tmp_path):
+    import json
+
+    # sample_every=2 so the post-resume phase (6 steps) completes ≥2 sampling
+    # windows and the percentile fields are populated
+    out = run_example(
+        "by_feature/telemetry.py",
+        "--project_dir", str(tmp_path), "--num_steps", "12", "--sample_every", "2",
+    )
+    assert "Telemetry demo complete" in out
+    assert re.search(r"goodput [\d.]+ after 1 restart", out)
+    records = [json.loads(l) for l in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    metrics = records[-1]["metrics"]
+    for key in ("step_time_p50_ms", "tokens_per_sec", "mfu", "compile_count", "goodput"):
+        assert key in metrics, sorted(metrics)
+    assert records[-1]["goodput"]["restarts"] == 1
+
+
 def test_tracking_example(tmp_path):
     import json
 

@@ -1,0 +1,456 @@
+"""Telemetry subsystem (ISSUE 2 tentpole): step-timer sampling cadence,
+compile tracking, MFU math, memory watermarks, goodput across a simulated
+SIGTERM save/resume, multi-host aggregation semantics, the profile() /
+`accelerate-tpu profile` satellites, and the end-to-end telemetry.jsonl demo
+(the acceptance-criteria smoke test — fast, tier-1)."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, CheckpointManager, Telemetry, TelemetryConfig
+from accelerate_tpu.models.config import get_config, param_count, train_flops_per_step
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.telemetry import CompileTracker, GoodputTracker, StepTimer
+from accelerate_tpu.telemetry.profiler import ProfileWindow
+
+
+class Tiny:
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (8, 4), jnp.float32)}
+
+    @staticmethod
+    def apply(params, x):
+        return x @ params["w"]
+
+
+def _loss(params, batch):
+    return jnp.mean(Tiny.apply(params, batch) ** 2)
+
+
+def _reset_singletons():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+# ---------------------------------------------------------------------------
+# step timer
+# ---------------------------------------------------------------------------
+
+
+def test_step_timer_fences_only_on_sampling_cadence():
+    timer = StepTimer(sample_every=8)
+    x = jnp.ones(())
+    for _ in range(33):
+        timer.step(x)
+    # 33 steps at cadence 8 → boundaries at 8,16,24,32: exactly 4 fences
+    assert timer.fence_count == 4
+    # first boundary only sets the baseline: 3 completed windows
+    assert len(timer.samples) == 3
+    assert timer.steps == 33
+    summary = timer.summary()
+    assert summary["sampled_windows"] == 3
+    assert summary["step_time_p50_ms"] > 0
+    assert summary["steps_per_sec"] > 0
+
+
+def test_step_timer_discard_window_drops_stall():
+    timer = StepTimer(sample_every=2)
+    x = jnp.ones(())
+    for _ in range(4):
+        timer.step(x)
+    n = len(timer.samples)
+    timer.discard_window()  # e.g. a checkpoint save happened here
+    for _ in range(2):
+        timer.step(x)
+    # the window spanning the discard contributes no sample
+    assert len(timer.samples) == n
+    for _ in range(2):
+        timer.step(x)
+    assert len(timer.samples) == n + 1
+
+
+def test_step_timer_rejects_bad_cadence():
+    with pytest.raises(ValueError):
+        StepTimer(sample_every=0)
+
+
+# ---------------------------------------------------------------------------
+# FLOPs / MFU math
+# ---------------------------------------------------------------------------
+
+
+def test_train_flops_matches_hand_computation():
+    cfg = get_config("llama-tiny")
+    seq, batch = 64, 4
+    by_hand = batch * seq * (
+        6.0 * param_count(cfg) + 12.0 * cfg.num_layers * cfg.hidden_size * seq
+    )
+    assert train_flops_per_step(cfg, batch, seq) == by_hand
+
+
+def test_mfu_derivation_against_hand_computed_flops():
+    _reset_singletons()
+    acc = Accelerator(telemetry_config=TelemetryConfig(sample_every=4))
+    telemetry = acc.telemetry
+    cfg = get_config("llama-tiny")
+    peak = 1e12
+    telemetry.configure_throughput(cfg, batch_size=8, seq_len=32, peak_flops_per_device=peak)
+    # inject a known step time: 10 ms/step
+    telemetry.timer._record(0.1, 10)
+    telemetry.timer.steps = 10
+    metrics = telemetry.metrics()
+    flops = train_flops_per_step(cfg, 8, 32)
+    expected_mfu = flops * 100.0 / (peak * jax.device_count())
+    assert metrics["mfu"] == pytest.approx(expected_mfu)
+    assert metrics["tokens_per_sec"] == pytest.approx(8 * 32 * 100.0)
+    assert metrics["examples_per_sec"] == pytest.approx(8 * 100.0)
+
+
+# ---------------------------------------------------------------------------
+# compile tracking
+# ---------------------------------------------------------------------------
+
+
+def test_compile_tracker_counts_real_compiles_and_cache_events():
+    from accelerate_tpu.utils.jit_cache import dot_keyed_jit
+
+    with CompileTracker() as tracker:
+        f = jax.jit(lambda x: x * 3 + 1)
+        f(jnp.ones(7))   # compile
+        f(jnp.ones(7))   # cached
+        f(jnp.ones(11))  # new shape → compile
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        dot_keyed_jit(owner, "_cache", "k", lambda: "built")  # miss
+        dot_keyed_jit(owner, "_cache", "k", lambda: "built")  # hit
+    snap = tracker.snapshot()
+    assert snap["compile_count"] >= 2
+    assert snap["compile_seconds"] > 0
+    assert snap["jit_cache_misses"] == 1
+    assert snap["jit_cache_hits"] == 1
+    # stopped tracker stops accumulating
+    f(jnp.ones(13))
+    assert tracker.snapshot()["compile_count"] == snap["compile_count"]
+
+
+# ---------------------------------------------------------------------------
+# goodput across a simulated SIGTERM save/resume
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_bookkeeping_across_preemption_save_and_resume(tmp_path):
+    acc = Accelerator(telemetry_config=TelemetryConfig(sample_every=2, dir=str(tmp_path)))
+    acc.prepare(Tiny())
+    opt = acc.prepare_optimizer(optax.sgd(1e-2))
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path / "ckpts"), handle_signals=())
+    batch = jnp.ones((4, 8), jnp.float32)
+    for _ in range(4):
+        loss = acc.backward(_loss, batch)
+        opt.step()
+        opt.zero_grad()
+        acc.telemetry.step(loss)
+    manager.request_preemption()  # simulated SIGTERM (handler just flips this flag)
+    assert manager.should_save(4)
+    manager.save(4)
+    assert manager.exit_requested
+    saved = acc.telemetry.goodput._lost
+    assert saved.get("checkpoint_save", 0) > 0
+    assert acc.telemetry.goodput._counts["checkpoint_save"] == 1
+
+    # "restart": fresh singletons + accelerator, as the relaunched process has
+    _reset_singletons()
+    acc2 = Accelerator(telemetry_config=TelemetryConfig(sample_every=2, dir=str(tmp_path)))
+    acc2.prepare(Tiny())
+    opt2 = acc2.prepare_optimizer(optax.sgd(1e-2))
+    manager2 = CheckpointManager(acc2, checkpoint_dir=str(tmp_path / "ckpts"), handle_signals=())
+    resume = manager2.resume("auto")
+    assert resume is not None and resume.step == 4
+    assert acc2.telemetry.goodput.restarts == 1
+    assert acc2.telemetry.goodput._lost.get("checkpoint_restore", 0) > 0
+    for _ in range(4):
+        loss = acc2.backward(_loss, batch)
+        opt2.step()
+        opt2.zero_grad()
+        acc2.telemetry.step(loss)
+    record = acc2.telemetry.flush()
+    goodput = record["goodput"]
+    assert goodput["restarts"] == 1
+    assert goodput["overhead_s"]["checkpoint_restore"] > 0
+    assert goodput["lost_s"] > 0
+    assert 0 < goodput["goodput"] <= 1
+
+
+def test_goodput_tracker_ledger_math():
+    tracker = GoodputTracker()
+    tracker.record("checkpoint_save", 2.0)
+    tracker.record("checkpoint_save", 1.0)
+    with tracker.timer("dataloader_rewind"):
+        pass
+    snap = tracker.snapshot(productive_seconds=12.0, compile_seconds=3.0)
+    # compile came only from monitoring → added on top of the ledger
+    assert snap["lost_s"] == pytest.approx(3.0 + 3.0, abs=0.1)
+    assert snap["goodput"] == pytest.approx(12.0 / (12.0 + snap["lost_s"]), abs=1e-4)
+    assert snap["event_counts"]["checkpoint_save"] == 2
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_metrics_single_process_identity():
+    state = PartialState()
+    agg = state.aggregate_metrics({"a": 2.0, "b": 3, "skip": "str", "flag": True})
+    assert agg["a"] == {"min": 2.0, "max": 2.0, "mean": 2.0}
+    assert agg["b"] == {"min": 3.0, "max": 3.0, "mean": 3.0}
+    assert "skip" not in agg and "flag" not in agg
+
+
+# ---------------------------------------------------------------------------
+# the acceptance demo: CPU-backend end-to-end telemetry.jsonl
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_jsonl_end_to_end_with_save_resume(tmp_path):
+    """The ISSUE acceptance criterion: a CPU run produces telemetry.jsonl with
+    step_time percentiles, compile events, memory watermarks, tokens/sec, MFU,
+    and a goodput ratio after a simulated save/resume — with zero forced
+    fences outside the sampling cadence."""
+    sample_every = 4
+    config = TelemetryConfig(sample_every=sample_every, dir=str(tmp_path))
+    acc = Accelerator(telemetry_config=config)
+    acc.prepare(Tiny())
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    step = acc.compiled_step(_loss)
+    cfg = get_config("llama-tiny")
+    acc.telemetry.configure_throughput(
+        cfg, batch_size=16, seq_len=8, peak_flops_per_device=1e12
+    )
+    manager = CheckpointManager(acc, checkpoint_dir=str(tmp_path / "ckpts"), handle_signals=())
+    batch = jnp.ones((16, 8), jnp.float32)
+    for _ in range(8):
+        loss = step(batch)
+        acc.telemetry.step(loss)
+    manager.request_preemption()
+    manager.save(8)
+
+    _reset_singletons()
+    acc2 = Accelerator(telemetry_config=config)
+    acc2.prepare(Tiny())
+    acc2.prepare_optimizer(optax.sgd(1e-2))
+    step2 = acc2.compiled_step(_loss)
+    acc2.telemetry.configure_throughput(
+        cfg, batch_size=16, seq_len=8, peak_flops_per_device=1e12
+    )
+    manager2 = CheckpointManager(acc2, checkpoint_dir=str(tmp_path / "ckpts"), handle_signals=())
+    assert manager2.resume("auto").step == 8
+    n_steps = 16
+    for _ in range(n_steps):
+        loss = step2(batch)
+        acc2.telemetry.step(loss)
+    # zero forced sync outside the cadence: one fence per completed boundary
+    assert acc2.telemetry.timer.fence_count == n_steps // sample_every
+    acc2.telemetry.finish()
+
+    records = [json.loads(l) for l in open(tmp_path / "telemetry.jsonl")]
+    record = records[-1]
+    metrics = record["metrics"]
+    for key in (
+        "step_time_p50_ms",
+        "step_time_p90_ms",
+        "step_time_p99_ms",
+        "steps_per_sec",
+        "tokens_per_sec",
+        "mfu",
+        "compile_count",
+        "goodput",
+    ):
+        assert key in metrics, f"missing {key} in {sorted(metrics)}"
+    assert metrics["compile_count"] > 0, "compile events not captured"
+    assert record["compiles"]["events"], "per-event compile detail missing"
+    # memory watermarks: device stats on TPU, host RSS watermark on CPU
+    assert record["memory"].get("host_peak_rss_bytes") or record["memory"].get(
+        "hbm_high_watermark_bytes"
+    )
+    assert record["goodput"]["restarts"] == 1
+    assert record["goodput"]["overhead_s"]["checkpoint_restore"] > 0
+    assert 0 < metrics["goodput"] <= 1
+    assert metrics["mfu"] > 0
+    assert record["aggregate"]["steps"]["mean"] == n_steps
+    assert metrics["optimizer_steps"] == n_steps
+
+
+# ---------------------------------------------------------------------------
+# satellites: profile(), JSONL tracker, rank-aware logging, profile CLI
+# ---------------------------------------------------------------------------
+
+
+def test_profile_is_reentrancy_safe_and_snapshots_memory(tmp_path):
+    acc = Accelerator()
+    with acc.profile(str(tmp_path / "trace"), host_metadata={"run": "t1"}) as capture:
+        with pytest.raises(RuntimeError, match="already active"):
+            with acc.profile(str(tmp_path / "nested")):
+                pass
+        (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
+    # still a str (os.walk call sites keep working) with snapshot attributes
+    assert isinstance(capture, str) and capture == str(tmp_path / "trace")
+    assert isinstance(capture.memory_before, list)
+    assert isinstance(capture.memory_after, list)
+    meta = json.load(open(tmp_path / "trace" / "host_metadata.json"))
+    assert meta["run"] == "t1" and meta["process_index"] == 0
+    # the guard releases: profiling again works
+    with acc.profile(str(tmp_path / "trace2")):
+        pass
+
+
+def test_jsonl_tracker_coerces_scalars_and_fsyncs(tmp_path):
+    from accelerate_tpu.tracking import JSONLTracker
+
+    tracker = JSONLTracker("run", logging_dir=str(tmp_path))
+    tracker.log(
+        {
+            "jax_scalar": jnp.float32(1.5),
+            "np_scalar": np.float64(2.5),
+            "np_int": np.int64(7),
+            "arr": np.arange(3),
+            "weird": {("a", "b"): 1},  # tuple key: unserializable structure
+        },
+        step=0,
+    )
+    tracker.finish()
+    tracker.finish()  # double-finish must not raise
+    [line] = [json.loads(l) for l in open(tmp_path / "run" / "metrics.jsonl")]
+    assert line["jax_scalar"] == 1.5  # a NUMBER, not the string "1.5"
+    assert line["np_scalar"] == 2.5
+    assert line["np_int"] == 7
+    assert line["arr"] == [0, 1, 2]
+    assert line["weird"] == {"('a', 'b')": 1}
+
+
+def test_logging_stamps_process_index():
+    from accelerate_tpu.logging import get_logger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = get_logger("telemetry_rank_test", log_level="INFO")
+    handler = Capture()
+    logger.logger.addHandler(handler)
+    try:
+        logger.info("hello")
+        logger.info("everyone", main_process_only=False)
+    finally:
+        logger.logger.removeHandler(handler)
+    assert len(records) == 2
+    for record in records:
+        assert record.process_index == 0
+        assert record.local_process_index == 0
+    # formatters can surface the stamp
+    fmt = logging.Formatter("[rank %(process_index)s] %(message)s")
+    assert fmt.format(records[0]) == "[rank 0] hello"
+
+
+def test_profile_cli_builds_window_env(tmp_path):
+    import argparse
+
+    from accelerate_tpu.commands import profile as profile_cmd
+
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers()
+    profile_cmd.register_subcommand(sub)
+    args = parser.parse_args(
+        [
+            "profile", "--output-dir", str(tmp_path), "--start-step", "100",
+            "--num-steps", "20", "--port", "9999", "train.py", "--epochs", "1",
+        ]
+    )
+    env = profile_cmd.build_env(args)
+    assert env["ACCELERATE_PROFILE_DIR"] == str(tmp_path)
+    assert env["ACCELERATE_PROFILE_START_STEP"] == "100"
+    assert env["ACCELERATE_PROFILE_STEPS"] == "20"
+    assert env["ACCELERATE_PROFILE_PORT"] == "9999"
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--epochs", "1"]
+
+
+def test_profile_window_env_arming_and_step_boundaries(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("ACCELERATE_PROFILE_START_STEP", "3")
+    monkeypatch.setenv("ACCELERATE_PROFILE_STEPS", "2")
+    window = ProfileWindow.from_env()
+    assert window is not None and window.armed
+    started, stopped = [], []
+    monkeypatch.setattr(window, "_start", lambda: (started.append(True), setattr(window, "active", True)))
+
+    def stop():
+        stopped.append(True)
+        window.active = False
+        window.completed = True
+
+    monkeypatch.setattr(window, "_stop", stop)
+    for step in range(8):
+        window.on_step(step)
+    assert len(started) == 1 and len(stopped) == 1
+    assert not window.armed  # one-shot: never rearms
+
+
+def test_profile_window_writes_real_trace(tmp_path):
+    window = ProfileWindow(output_dir=str(tmp_path), start_step=1, num_steps=2)
+    for step in range(5):
+        (jnp.ones((4, 4)) * step).block_until_ready()
+        window.on_step(step)
+    assert window.completed
+    trace_dir = os.path.join(str(tmp_path), "host_0")
+    found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert found, "profiler window produced no trace files"
+
+
+def test_flush_every_and_canonical_loop_emit_one_record_per_boundary(tmp_path):
+    acc = Accelerator(
+        telemetry_config=TelemetryConfig(sample_every=2, flush_every=4, dir=str(tmp_path))
+    )
+    telemetry = acc.telemetry
+    x = jnp.ones(())
+    for _ in range(8):
+        telemetry.step(x)
+        if telemetry.should_flush():  # the hub docstring's canonical loop
+            telemetry.flush(step=telemetry.steps)
+    telemetry.finish(flush=False)
+    telemetry.finish(flush=False)  # idempotent
+    records = [json.loads(l) for l in open(tmp_path / "telemetry.jsonl")]
+    # auto-flush and should_flush() compose: exactly one record per boundary
+    assert [r["step"] for r in records] == [4, 8]
+
+
+def test_finish_is_idempotent_no_duplicate_final_record(tmp_path):
+    acc = Accelerator(telemetry_config=TelemetryConfig(sample_every=2, dir=str(tmp_path)))
+    for _ in range(4):
+        acc.telemetry.step(jnp.ones(()))
+    acc.telemetry.finish()
+    acc.end_training()  # calls finish() again — must be a no-op
+    records = [json.loads(l) for l in open(tmp_path / "telemetry.jsonl")]
+    assert len(records) == 1
+
+
+def test_telemetry_disabled_is_inert(tmp_path):
+    acc = Accelerator(telemetry_config=TelemetryConfig(enabled=False, dir=str(tmp_path)))
+    acc.telemetry.step(jnp.ones(()))
+    assert acc.telemetry.flush() is None
+    acc.telemetry.finish()
+    assert acc.telemetry.timer.steps == 0
+    assert not os.path.exists(tmp_path / "telemetry.jsonl")
