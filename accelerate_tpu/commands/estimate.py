@@ -32,6 +32,15 @@ def register_subcommand(subparsers):
         "checkpoint path (.safetensors/.npz file or directory)",
     )
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8"])
+    parser.add_argument(
+        "--max-seq-len", type=int, default=None,
+        help="KV-cache sequence capacity for the inference column "
+        "(default: the model config's max_seq_len)",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=1,
+        help="Concurrent sequences (serving slots) for the KV-cache estimate",
+    )
     parser.set_defaults(func=run)
     return parser
 
@@ -131,6 +140,7 @@ def _config_json_path(path: str) -> str | None:
 
 
 def run(args) -> int:
+    config = None  # set when the input names a known geometry → KV estimate
     config_json = _config_json_path(args.model_name) if os.path.exists(args.model_name) else None
     if config_json is not None:
         from ..models.config import config_from_hf_json, param_count
@@ -161,8 +171,42 @@ def run(args) -> int:
         print(f"Largest tensor: {largest_key} {list(largest_shape)} {largest_dtype}")
     else:
         n = count_params(args.model_name)
+        if not args.model_name.startswith("params="):
+            from ..models import get_config
+
+            config = get_config(args.model_name)
         print(f"Model: {args.model_name} — {n / 1e9:.2f}B parameters")
-    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}"
+
+    # KV cache for serving: without it, serve sizing is silently off by
+    # 2·L·KV·D·S·B bytes per replica — often the difference between a model
+    # "fitting" and OOMing the moment slots fill. The decoder-only formula
+    # covers the archs the serving engine decodes (llama/gpt2); bert has no
+    # decode cache and t5's per-stack layers + cross-attention cache need a
+    # different formula, so both are skipped LOUDLY rather than printed
+    # wrong. The cache dtype follows the compute dtype (weight-only int8/int4
+    # still decode with a bf16 cache).
+    kv_batch = getattr(args, "batch", None) or 1
+    kv_seq = getattr(args, "max_seq_len", None)
+    kv_fn = None
+    if config is not None and config.arch in ("llama", "gpt2"):
+        from ..serving.kv_cache import kv_cache_bytes
+
+        kv_seq = kv_seq or config.max_seq_len
+        kv_fn = lambda dtype_bytes: kv_cache_bytes(config, kv_batch, kv_seq, dtype_bytes)  # noqa: E731
+        print(
+            f"KV cache (batch={kv_batch}, seq={kv_seq}): "
+            f"{_convert_bytes(kv_fn(2))} bf16 / {_convert_bytes(kv_fn(4))} fp32"
+        )
+    elif kv_seq is not None:
+        reason = (
+            "needs a model config (registry name or config.json)"
+            if config is None
+            else f"decoder-only formula does not cover arch {config.arch!r}"
+        )
+        print(f"KV cache: {reason}, skipping")
+
+    kv_col = f" | {'+kv (serve)':>12}" if kv_fn is not None else ""
+    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}{kv_col}"
     print(header)
     print("-" * len(header))
     for dtype in args.dtypes:
@@ -170,5 +214,9 @@ def run(args) -> int:
         params = n * b
         # grads stored in the same dtype; Adam keeps two fp32 moments + fp32 master params
         train = params + n * b + n * 4 * 3
-        print(f"{dtype:>10} | {_convert_bytes(params):>10} | {_convert_bytes(params * 2):>10} | {_convert_bytes(train):>14}")
+        row = f"{dtype:>10} | {_convert_bytes(params):>10} | {_convert_bytes(params * 2):>10} | {_convert_bytes(train):>14}"
+        if kv_fn is not None:
+            serve = params + kv_fn(4 if dtype == "float32" else 2)
+            row += f" | {_convert_bytes(serve):>12}"
+        print(row)
     return 0

@@ -1,0 +1,134 @@
+"""`accelerate-tpu serve-bench` — drive the continuous-batching engine under
+offered load and report serving metrics.
+
+The serving analogue of `bench.py`'s training sections: a deterministic
+mixed-length prompt trace replays against :class:`serving.ServingEngine` at
+one or more offered rates (requests/sec; the final sweep point is always
+saturation — everything at once), and each point reports throughput,
+TTFT/per-token percentiles, slot occupancy, and compile attribution. Works
+on any backend (the CPU mesh included), so serve sizing can be rehearsed
+before touching a TPU.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "serve-bench", help="Benchmark the continuous-batching serving engine"
+    )
+    parser.add_argument("--model", default="llama-125m", help="Registry model name")
+    parser.add_argument("--num-slots", type=int, default=8, help="Concurrent decode slots")
+    parser.add_argument("--max-len", type=int, default=512, help="Per-slot KV capacity (tokens)")
+    parser.add_argument("--requests", type=int, default=32, help="Requests per sweep point")
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--prompt-len-min", type=int, default=16)
+    parser.add_argument("--prompt-len-max", type=int, default=192)
+    parser.add_argument(
+        "--offered-load",
+        type=float,
+        nargs="*",
+        default=[],
+        help="Offered rates (req/s) to sweep before the saturation point",
+    )
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--eos-token-id", type=int, default=None)
+    parser.add_argument("--int8", action="store_true", help="int8 weight-only load path")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", action="store_true", help="One JSON object instead of a table")
+    parser.set_defaults(func=run)
+    return parser
+
+
+def run(args) -> int:
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import build_model
+    from ..serving import ServingEngine, make_prompts, run_offered_load
+
+    model = build_model(args.model)
+    params = model.init(jax.random.key(args.seed))
+    if jax.default_backend() != "cpu":
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+        )
+    if args.int8:
+        from ..big_modeling import dispatch_model, make_layered_device_map
+        from ..serving import params_from_streamed
+        from ..utils.quantization import QuantizationConfig
+
+        streamed = dispatch_model(
+            model, params, make_layered_device_map(model, "cpu"),
+            dtype=params["embed_tokens"].dtype, quantization=QuantizationConfig(load_in_8bit=True),
+        )
+        params = params_from_streamed(streamed)
+
+    prompts = make_prompts(
+        args.requests, model.config.vocab_size, args.prompt_len_min, args.prompt_len_max,
+        seed=args.seed,
+    )
+
+    def fresh_engine():
+        # one model instance across engines: the jit cache lives on it, so
+        # only the FIRST engine compiles — later sweep points measure clean
+        return ServingEngine(
+            model, params, num_slots=args.num_slots, max_len=args.max_len,
+            eos_token_id=args.eos_token_id, temperature=args.temperature,
+        )
+
+    # warmup: one synthetic request per prefill bucket + the decode step —
+    # deterministic full coverage, so no sweep point ever straddles a compile
+    warm_engine = fresh_engine()
+    warm_engine.warmup()
+    warm = warm_engine.metrics()
+    points = [
+        run_offered_load(fresh_engine(), prompts, args.max_new_tokens, offered_rps=rate)
+        for rate in args.offered_load
+    ]
+    points.append(run_offered_load(fresh_engine(), prompts, args.max_new_tokens, math.inf))
+
+    payload = {
+        "model": args.model,
+        "num_slots": args.num_slots,
+        "max_len": args.max_len,
+        "requests": args.requests,
+        "max_new_tokens": args.max_new_tokens,
+        "int8": bool(args.int8),
+        # each sweep point's engine carries its own CompileTracker, scoped to
+        # its lifetime: the saturation point's count IS the steady-state count
+        "warmup_compile_count": warm["compile_count"],
+        "steady_state_compile_count": points[-1]["compile_count"],
+        "sweep": points,
+    }
+    if args.json:
+        print(json.dumps(payload))
+        return 0
+    print(
+        f"serve-bench {args.model}: {args.num_slots} slots × {args.max_len} tokens, "
+        f"{args.requests} requests, max_new={args.max_new_tokens}"
+        + (", int8 weights" if args.int8 else "")
+    )
+    print(
+        f"compiles: {payload['warmup_compile_count']} at warmup, "
+        f"{payload['steady_state_compile_count']} after (steady state must be 0)"
+    )
+    header = (
+        f"{'offered req/s':>14} | {'tok/s':>9} | {'ttft p50':>9} | {'ttft p99':>9} | "
+        f"{'tok p50':>8} | {'tok p99':>8} | {'occupancy':>9}"
+    )
+    print(header)
+    print("-" * len(header))
+    for point in points:
+        rate = "saturate" if point["offered_rps"] is None else f"{point['offered_rps']:g}"
+        print(
+            f"{rate:>14} | {point['throughput_tokens_per_sec']:>9.1f} | "
+            f"{point.get('ttft_p50_ms', 0):>7.1f}ms | {point.get('ttft_p99_ms', 0):>7.1f}ms | "
+            f"{point.get('per_token_p50_ms', 0):>6.1f}ms | {point.get('per_token_p99_ms', 0):>6.1f}ms | "
+            f"{point['slot_occupancy']:>9.2f}"
+        )
+    return 0

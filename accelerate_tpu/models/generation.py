@@ -68,12 +68,43 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
     return logits.astype(jnp.float32), new_cache
 
 
-def _jit_for(model, name: str, build):
+def _jit_for(model, name, build):
     """Per-model jit cache so repeated generate() calls reuse compilations;
     dot_fn-invalidated (see utils/jit_cache.py)."""
     from ..utils.jit_cache import dot_keyed_jit
 
     return dot_keyed_jit(model, "_jit_cache", name, build)
+
+
+def resolve_decode_protocol(model):
+    """``(init_cache, forward_with_cache)`` for any causal model.
+
+    Models that implement the decode protocol themselves (GPT2) contribute
+    their own methods; the llama family's protocol lives in this module.
+    Both ``generate`` and the serving engine (``serving/``) drive models
+    exclusively through this pair, so a new family only has to implement the
+    protocol once to get batch generation AND continuous-batching serving.
+    """
+    if hasattr(model, "forward_with_cache"):
+        return model.init_cache, model.forward_with_cache
+    return (
+        lambda batch, max_len, dtype=jnp.bfloat16: init_cache(model.config, batch, max_len, dtype=dtype),
+        lambda p, ids, c: forward_with_cache(model, p, ids, c),
+    )
+
+
+def make_sampler(temperature: float):
+    """Greedy (temperature<=0) or categorical token sampler over last-position
+    logits [..., V] → int32 ids. Shared by generate() and the serving engine
+    so the two paths can never sample differently at the same temperature."""
+    greedy = temperature <= 0.0
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    return sample
 
 
 def generate(
@@ -89,39 +120,32 @@ def generate(
     """Greedy (temperature=0) or sampled generation. Returns [B, S+new] ids.
 
     ``return_device=True`` returns the concatenated ids as a DEVICE array with
-    no host fetch (and no eos truncation, which is host-side) — benchmarks use
-    it so the clock can stop on ``block_until_ready`` instead of paying the
-    transport's fixed device→host fetch latency inside the timed region.
+    no host fetch — benchmarks use it so the clock can stop on
+    ``block_until_ready`` instead of paying the transport's fixed device→host
+    fetch latency inside the timed region.
+
+    ``eos_token_id`` carries a per-row done mask through the decode scan:
+    once a row emits EOS, every later position feeds and emits EOS (a no-op
+    token), so finished rows stop contributing fresh decode work and the
+    output arrives already EOS-filled — on device, so it composes with
+    ``return_device``.
 
     Works for any causal model implementing the decode protocol —
     ``init_cache(batch, max_len, dtype)`` + ``forward_with_cache(params, ids,
     cache) -> (last logits, cache)`` (GPT2 here) — with the llama family's
     protocol provided by this module."""
-    if return_device and eos_token_id is not None:
-        raise ValueError(
-            "return_device=True skips eos truncation (a host-side operation); "
-            "pass one or the other, or truncate after fetching."
-        )
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     max_len = s + max_new_tokens
     dtype = params["embed_tokens"].dtype
-    if hasattr(model, "forward_with_cache"):
-        cache = model.init_cache(b, max_len, dtype=dtype)
-        fwc = model.forward_with_cache
-    else:
-        cache = init_cache(model.config, b, max_len, dtype=dtype)
-        fwc = lambda p, ids, c: forward_with_cache(model, p, ids, c)  # noqa: E731
+    cache_init, fwc = resolve_decode_protocol(model)
+    cache = cache_init(b, max_len, dtype=dtype)
 
     prefill = _jit_for(model, "prefill", lambda: jax.jit(lambda p, ids, c: fwc(p, ids, c)))
     logits, cache = prefill(params, input_ids, cache)
 
     greedy = temperature <= 0.0
-
-    def sample(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+    sample = make_sampler(temperature)
 
     if rng is None:
         rng = jax.random.key(0)
@@ -130,27 +154,28 @@ def generate(
 
     def decode_loop(params, cache, first, keys):
         def step(carry, key):
-            cache, token = carry
+            cache, token, done = carry
             logits, cache = fwc(params, token[:, None], cache)
             nxt = sample(logits, key)
-            return (cache, nxt), nxt
+            if eos_token_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+                done = done | (nxt == eos_token_id)
+            return (cache, nxt, done), nxt
 
-        return jax.lax.scan(step, (cache, first), keys)
+        done = (
+            first == eos_token_id if eos_token_id is not None else jnp.zeros(first.shape, bool)
+        )
+        return jax.lax.scan(step, (cache, first, done), keys)
 
     if max_new_tokens > 1:
-        # temperature is baked into the traced program — key the cache on it
-        decode = _jit_for(model, f"decode_g{greedy}_t{temperature}", lambda: jax.jit(decode_loop))
-        (_, _), rest = decode(params, cache, first, keys[1:])
+        # temperature and the eos mask are baked into the traced program —
+        # key the cache on both
+        decode = _jit_for(
+            model, f"decode_g{greedy}_t{temperature}_e{eos_token_id}", lambda: jax.jit(decode_loop)
+        )
+        (_, _, _), rest = decode(params, cache, first, keys[1:])
         tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     else:
         tokens = first[:, None]
-    if return_device:
-        return jnp.concatenate([input_ids, tokens], axis=1)
-    out = np.concatenate([np.asarray(input_ids), np.asarray(tokens)], axis=1)
-    if eos_token_id is not None:
-        # truncate after first EOS per row (host-side cosmetic)
-        for row in range(b):
-            hits = np.where(out[row, s:] == eos_token_id)[0]
-            if hits.size:
-                out[row, s + hits[0] + 1 :] = eos_token_id
-    return out
+    out = jnp.concatenate([input_ids, tokens], axis=1)
+    return out if return_device else np.asarray(out)

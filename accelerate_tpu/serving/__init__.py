@@ -1,0 +1,30 @@
+"""Continuous-batching inference: slot KV cache, scheduler, serving engine.
+
+The first subsystem on the inference side of the stack (see
+docs/serving.md): one fixed-shape jitted decode step stays hot while
+requests of any prompt length multiplex through preallocated cache slots —
+zero steady-state recompiles, per-step admission, immediate slot reuse on
+EOS. Later serving work (paging, multi-host serve meshes, speculative
+decoding) builds on these pieces.
+"""
+
+from .engine import ServingEngine, ServingResult, params_from_streamed
+from .kv_cache import SlotAllocator, SlotKVCache, bucket_for, kv_cache_bytes, prefill_buckets
+from .loadgen import make_prompts, run_offered_load
+from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
+
+__all__ = [
+    "ContinuousBatchingScheduler",
+    "QueueFull",
+    "Request",
+    "ServingEngine",
+    "ServingResult",
+    "SlotAllocator",
+    "SlotKVCache",
+    "bucket_for",
+    "kv_cache_bytes",
+    "make_prompts",
+    "params_from_streamed",
+    "prefill_buckets",
+    "run_offered_load",
+]

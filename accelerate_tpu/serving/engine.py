@@ -1,0 +1,396 @@
+"""The continuous-batching serving engine.
+
+``models/generation.generate()`` is batch-synchronous: every new ``[B, S]``
+prompt shape re-jits its prefill, and a finished row keeps burning decode
+FLOPs until the whole batch hits ``max_new_tokens``. The engine inverts
+this: ONE fixed-shape decode program stays hot forever and requests
+multiplex through it via the slot cache —
+
+- **decode** is the models' own ``forward_with_cache`` protocol ``vmap``-ed
+  over the slot axis with per-slot lengths: the protocol is reused
+  *unchanged* (each slot sees a batch-of-1 cache view and a scalar length),
+  and the program's shapes — ``[num_slots]`` tokens/lengths/active, the full
+  slot cache — never depend on which requests are in flight;
+- **prefill** runs the same protocol over a prompt padded to a power-of-two
+  bucket, into a private bucket-length cache, then one ``dynamic_update_slice``
+  inserts the K/V into the request's slot. Only ``prompt[:-1]`` prefills: the
+  request's first token falls out of its first decode step, so logits at
+  padded positions are never needed and prefill output is dropped entirely;
+- **scheduling** is host-side (``scheduler.py``): admission control, FIFO
+  admit into free slots, EOS/max-token retirement that frees the slot for
+  the very next step.
+
+After warmup (one prefill+insert program per bucket + one decode program),
+steady state compiles NOTHING — the acceptance invariant
+``tests/test_serving.py`` pins with ``CompileTracker``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import make_sampler, resolve_decode_protocol
+from ..telemetry.serving import ServingStats
+from ..utils.jit_cache import dot_keyed_jit
+from .kv_cache import SlotKVCache, bucket_for, prefill_buckets
+from .scheduler import ContinuousBatchingScheduler, QueueFull, Request  # noqa: F401 (re-export)
+
+
+@dataclass
+class ServingResult:
+    """One finished request: ids + the latency the user actually saw."""
+
+    request_id: int
+    prompt: np.ndarray  # [S]
+    generated: np.ndarray  # [<= max_new_tokens], ends with EOS when hit
+    finish_reason: str  # "eos" | "length"
+    ttft_s: float
+    latency_s: float
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """Full sequence, prompt + generated."""
+        return np.concatenate([self.prompt, self.generated])
+
+
+def params_from_streamed(streamed) -> dict:
+    """Reassemble full device-resident params from a ``StreamedModel``.
+
+    This is the int8 serving load path: ``dispatch_model(..., quantization=
+    QuantizationConfig(load_in_8bit=True))`` holds layers as packed int8 host
+    buffers, so the H2D transfer here moves half (int8) or a quarter (int4)
+    of the bf16 bytes and dequantizes ON DEVICE per layer — host RAM, disk,
+    and transfer bandwidth all shrink by the quantization ratio while the
+    resident compute stays in the streamer's dtype (W8A16 semantics, same as
+    the streamed path). Works just as well unquantized: any checkpoint the
+    big-model loader can place becomes a resident serving param tree.
+    """
+    from ..big_modeling import _device_put_packed
+
+    streamed._before_execute()  # restore() if a pipeline hook evicted it
+    params = streamed.resident_tree()
+    layers = []
+    for i, buf in enumerate(streamed.layer_buffers):
+        if not streamed.layer_on_device[i]:
+            buf = _device_put_packed(buf)  # int8 packs ride the DMA quantized
+        layers.append(streamed.packer.unpack(buf))  # dequantize on device
+    params["layers"] = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    return params
+
+
+class ServingEngine:
+    """Slot-multiplexed decode over any model with the decode protocol.
+
+    ``submit()`` / ``step()`` / ``run()`` are the async-style surface a real
+    server loops on; ``generate_many()`` is the blocking convenience that
+    matches ``generate()``'s output contract exactly (same ids at
+    temperature 0, EOS-padded to ``S + max_new_tokens``).
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: dict,
+        num_slots: int = 8,
+        max_len: int = 512,
+        buckets: Optional[Sequence[int]] = None,
+        eos_token_id: Optional[int] = None,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+        dtype=None,
+        max_queue: Optional[int] = None,
+        telemetry: Any = None,
+    ):
+        self.model = model
+        self.params = params
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self._sample = make_sampler(temperature)
+        self._init_cache, self._fwc = resolve_decode_protocol(model)
+        dtype = dtype if dtype is not None else params["embed_tokens"].dtype
+        self.cache = SlotKVCache(self._init_cache, num_slots, max_len, dtype=dtype)
+        self.buckets = tuple(buckets) if buckets is not None else prefill_buckets(max_len - 1)
+        if max(self.buckets) > max_len:
+            raise ValueError(f"largest bucket {max(self.buckets)} exceeds max_len {max_len}")
+        self.scheduler = ContinuousBatchingScheduler(num_slots, max_queue=max_queue)
+        self._pending = np.zeros((num_slots,), np.int32)  # next input token per slot
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self._prefill_caches: dict[int, dict] = {}  # zero cache template per bucket
+        # cache donation halves decode HBM traffic; unsupported on CPU (warns)
+        self._donate = jax.default_backend() in ("tpu", "gpu")
+        self.telemetry = telemetry
+        self.stats = ServingStats(num_slots)
+        if telemetry is not None:
+            self.compiles = telemetry.compiles
+        else:
+            from ..telemetry.compile_tracker import CompileTracker
+
+            self.compiles = CompileTracker().start()
+        self._steps = 0
+
+    # -- jitted programs (dot-keyed: shared cache with generate()) ----------
+
+    def _jit(self, key, build):
+        return dot_keyed_jit(self.model, "_jit_cache", key, build)
+
+    def _decode_program(self):
+        fwc, sample = self._fwc, self._sample
+
+        def build():
+            def decode_step(params, k, v, tokens, lengths, active, keys):
+                def one_slot(token, k1, v1, length, key):
+                    # a batch-of-1 view of the slot: the decode protocol runs
+                    # UNCHANGED — vmap supplies the per-slot length, which
+                    # drives positions and the causal-over-cache mask inside
+                    cache = {"k": k1[:, None], "v": v1[:, None], "length": length}
+                    logits, nc = fwc(params, token[None, None], cache)
+                    return sample(logits, key)[0], nc["k"][:, 0], nc["v"][:, 0]
+
+                nxt, k2, v2 = jax.vmap(one_slot, in_axes=(0, 1, 1, 0, 0), out_axes=(0, 1, 1))(
+                    tokens, k, v, lengths, keys
+                )
+                return jnp.where(active, nxt, jnp.int32(0)), k2, v2
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(decode_step, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_decode", self.cache.num_slots, self.cache.max_len, self.temperature), build
+        )
+
+    def _prefill_program(self, bucket: int):
+        fwc = self._fwc
+
+        def build():
+            def prefill(params, ids, cache):
+                _, nc = fwc(params, ids, cache)  # logits dropped by design
+                return nc["k"], nc["v"]  # [L, 1, bucket, KV, D]
+
+            return jax.jit(prefill)
+
+        return self._jit(("serve_prefill", bucket), build)
+
+    def _insert_program(self, bucket: int):
+        def build():
+            def insert(k, v, slot_k, slot_v, slot):
+                k = jax.lax.dynamic_update_slice(k, slot_k.astype(k.dtype), (0, slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(v, slot_v.astype(v.dtype), (0, slot, 0, 0, 0))
+                return k, v
+
+            donate = (0, 1) if self._donate else ()
+            return jax.jit(insert, donate_argnums=donate)
+
+        return self._jit(("serve_insert", bucket, self.cache.num_slots, self.cache.max_len), build)
+
+    def _prefill_cache(self, bucket: int) -> dict:
+        """Zero cache template per bucket — jax arrays are immutable, so one
+        template serves every admission at that bucket."""
+        if bucket not in self._prefill_caches:
+            self._prefill_caches[bucket] = self._init_cache(1, bucket, dtype=self.cache.dtype)
+        return self._prefill_caches[bucket]
+
+    # -- request intake ----------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile every program the engine can ever need: one synthetic
+        single-token request per prefill bucket (plus the shared decode
+        step). After this, steady state compiles nothing regardless of the
+        traffic mix — benchmarks call it so no measurement window ever
+        straddles a compile."""
+        for bucket in self.buckets:
+            length = min(bucket + 1, self.cache.max_len)
+            self.submit(np.zeros((length,), np.int32), max_new_tokens=1)
+        self.run()
+
+    @property
+    def queue_available(self) -> bool:
+        """Whether ``submit`` would pass admission control right now."""
+        max_queue = self.scheduler.max_queue
+        return max_queue is None or self.scheduler.waiting < max_queue
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        request_id: Optional[int] = None,
+        submitted_at: Optional[float] = None,
+    ) -> int:
+        """Enqueue one request; returns its id. Raises ``ValueError`` for
+        prompts the engine can never serve (too long for the cache) and
+        :class:`QueueFull` when admission control rejects.
+
+        ``submitted_at`` (a ``time.perf_counter`` stamp) backdates the
+        request for latency accounting — load generators pass the intended
+        arrival time so queue-full deferral shows up in TTFT instead of
+        vanishing from it."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        prefill_len = prompt.size - 1
+        if prefill_len > max(self.buckets):
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest prefill bucket "
+                f"{max(self.buckets)} + 1"
+            )
+        if prefill_len + max_new_tokens > self.cache.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the slot capacity max_len={self.cache.max_len}"
+            )
+        try:
+            request = self.scheduler.submit(
+                prompt, max_new_tokens, request_id=request_id, submitted_at=submitted_at
+            )
+        except QueueFull:
+            self.stats.record_reject()
+            raise
+        self.stats.record_submit()
+        return request.id
+
+    def _admit(self, slot: int, request: Request) -> None:
+        prefill_len = request.prompt.size - 1
+        if prefill_len > 0:
+            bucket = bucket_for(prefill_len, self.buckets)
+            request.prefill_bucket = bucket
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :prefill_len] = request.prompt[:-1]
+            slot_k, slot_v = self._prefill_program(bucket)(
+                self.params, ids, self._prefill_cache(bucket)
+            )
+            self.cache.k, self.cache.v = self._insert_program(bucket)(
+                self.cache.k, self.cache.v, slot_k, slot_v, np.int32(slot)
+            )
+            self.stats.record_prefill(bucket)
+        # the prompt's last token is the first decode input: its logits ARE
+        # the request's first token, so prefill logits are never consumed
+        self._pending[slot] = request.prompt[-1]
+
+    # -- the engine loop ---------------------------------------------------
+
+    def step(self) -> list[ServingResult]:
+        """One engine iteration: admit into free slots, run one decode step
+        over every active slot, retire finished requests. Returns the
+        requests that finished THIS step."""
+        t0 = time.perf_counter()
+        for slot, request in self.scheduler.admit_ready(
+            lambda req: self.cache.admit(req.prompt.size - 1)
+        ):
+            self._admit(slot, request)
+
+        active_idx = self.scheduler.active_slots
+        if not active_idx:
+            return []
+
+        keys = jax.random.split(jax.random.fold_in(self._rng, self._steps), self.cache.num_slots)
+        nxt, self.cache.k, self.cache.v = self._decode_program()(
+            self.params,
+            self.cache.k,
+            self.cache.v,
+            self._pending,
+            self.cache.lengths,
+            self.cache.active,
+            keys,
+        )
+        tokens = np.asarray(nxt)  # host fetch = the per-step fence + EOS gate
+        self._steps += 1
+        now = time.perf_counter()
+
+        finished: list[ServingResult] = []
+        for slot in active_idx:
+            request = self.scheduler.slots[slot]
+            token = int(tokens[slot])
+            request.generated.append(token)
+            self.cache.lengths[slot] += 1
+            if request.first_token_at is None:
+                request.first_token_at = now
+                self.stats.record_first_token(request.ttft_s)
+            hit_eos = self.eos_token_id is not None and token == self.eos_token_id
+            if hit_eos or len(request.generated) >= request.max_new_tokens:
+                self.cache.retire(slot)
+                done = self.scheduler.retire(slot, "eos" if hit_eos else "length")
+                self.stats.record_finish(done.latency_s)
+                finished.append(
+                    ServingResult(
+                        request_id=done.id,
+                        prompt=done.prompt,
+                        generated=np.asarray(done.generated, np.int32),
+                        finish_reason=done.finish_reason,
+                        ttft_s=done.ttft_s,
+                        latency_s=done.latency_s,
+                    )
+                )
+            else:
+                self._pending[slot] = token
+        self.stats.record_step(now - t0, active=len(active_idx), waiting=self.scheduler.waiting)
+        return finished
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def run(self) -> dict[int, ServingResult]:
+        """Drive ``step()`` until queue and slots drain; results by id."""
+        results: dict[int, ServingResult] = {}
+        while self.busy:
+            for result in self.step():
+                results[result.request_id] = result
+        return results
+
+    def generate_many(
+        self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32
+    ) -> list[np.ndarray]:
+        """Blocking batch API with ``generate()``'s exact output contract:
+        one ``[S_i + max_new_tokens]`` row per prompt, EOS-filled past the
+        first EOS — bit-identical to per-request ``generate`` at
+        temperature 0, whatever mix of lengths rides in."""
+        ids = [self.submit(p, max_new_tokens) for p in prompts]
+        results = self.run()
+        out = []
+        for prompt, rid in zip(prompts, ids):
+            r = results[rid]
+            row = np.concatenate([np.asarray(prompt, np.int32), r.generated])
+            full = np.asarray(prompt).size + max_new_tokens
+            if row.size < full:  # finished on EOS: pad like generate()'s done-mask
+                row = np.concatenate(
+                    [row, np.full((full - row.size,), self.eos_token_id, np.int32)]
+                )
+            out.append(row)
+        return out
+
+    # -- telemetry ---------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Engine metrics + compile attribution, flat scalars."""
+        out = self.stats.snapshot()
+        compiles = self.compiles.snapshot()
+        out["compile_count"] = compiles["compile_count"]
+        out["compile_seconds"] = compiles["compile_seconds"]
+        out["jit_cache_hits"] = compiles["jit_cache_hits"]
+        out["jit_cache_misses"] = compiles["jit_cache_misses"]
+        return out
+
+    def flush_telemetry(self) -> Optional[dict]:
+        """Emit a ``{"kind": "serving", ...}`` record through the hub's
+        jsonl sink (no-op without a hub — ``metrics()`` still works)."""
+        if self.telemetry is None:
+            return None
+        return self.telemetry.write_record("serving", {"serving": self.metrics()})
+
+    # -- alternate loaders -------------------------------------------------
+
+    @classmethod
+    def from_streamed(cls, streamed, **kwargs) -> "ServingEngine":
+        """Serve from a ``StreamedModel`` — the big-model loader (device
+        maps, int8/int4 quantization, disk offload) becomes the serving
+        checkpoint path: params reassemble on device via
+        :func:`params_from_streamed`, then decode runs resident."""
+        return cls(streamed.model, params_from_streamed(streamed), **kwargs)

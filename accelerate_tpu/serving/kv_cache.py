@@ -1,0 +1,155 @@
+"""Slot-based KV cache for continuous-batching inference.
+
+The cache is ONE preallocated region per layer — ``[L, num_slots, max_len,
+KV, D]`` — plus per-slot ``lengths``/``active`` host mirrors. A request of
+any prompt length occupies one slot without reshaping anything, so the decode
+step stays a single fixed-shape XLA program for the life of the engine:
+recompilation (the silent TPU serving killer — a new ``[B, S]`` per prompt
+shape in the batch-synchronous path) structurally cannot happen in steady
+state.
+
+Prefill is *bucketed*: prompts pad up to a small set of power-of-two lengths,
+so prefill compiles O(log S) programs instead of O(distinct prompt lengths).
+Padded positions write garbage K/V past the request's real length — harmless
+by construction, because the decode mask only admits key positions ``<= the
+slot's current length`` and every position is overwritten by the decode write
+before it first becomes visible.
+
+The allocator here is pure host bookkeeping (a free-slot stack); the device
+programs that fill and read the arrays live in ``serving/engine.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def prefill_buckets(max_prefill: int, min_bucket: int = 16) -> tuple[int, ...]:
+    """Power-of-two prefill lengths covering ``1..max_prefill``: O(log S)
+    compiled prefill programs. The last bucket is clamped to ``max_prefill``
+    so the largest program never pads past the cache."""
+    if max_prefill < 1:
+        raise ValueError(f"max_prefill must be >= 1, got {max_prefill}")
+    buckets: list[int] = []
+    b = min_bucket
+    while b < max_prefill:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prefill)
+    return tuple(buckets)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``n`` prefill tokens."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prefill length {n} exceeds largest bucket {buckets[-1]}")
+
+
+def kv_cache_bytes(
+    config, batch: int, max_seq_len: Optional[int] = None, dtype_bytes: int = 2
+) -> int:
+    """Device bytes of a full KV cache: ``2 (k+v) × layers × kv_heads ×
+    head_dim × max_len × batch × dtype_bytes``. Shared with
+    ``accelerate-tpu estimate-memory`` so serve sizing includes the cache."""
+    seq = max_seq_len if max_seq_len is not None else config.max_seq_len
+    return int(
+        2 * config.num_layers * config.kv_heads * config.dim_per_head * seq * batch * dtype_bytes
+    )
+
+
+class SlotAllocator:
+    """Free-slot stack: O(1) admit/retire, slots reused LIFO (a freshly
+    retired slot's cache lines are the hottest)."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._free = list(range(num_slots - 1, -1, -1))  # pop() yields slot 0 first
+        self._in_use: set[int] = set()
+
+    def admit(self) -> Optional[int]:
+        """Claim a free slot, or None when every slot is occupied."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def retire(self, slot: int) -> None:
+        """Release ``slot`` for immediate reuse (the very next admit)."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not in use")
+        self._in_use.discard(slot)
+        self._free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._in_use) / self.num_slots
+
+    def __contains__(self, slot: int) -> bool:
+        return slot in self._in_use
+
+
+class SlotKVCache:
+    """Device arrays + host mirrors of the slot state.
+
+    ``k``/``v`` are whatever the model's ``init_cache(num_slots, max_len)``
+    allocates (``[L, num_slots, max_len, KV, D]`` for the zoo families) —
+    slot ``i`` is index ``i`` of the batch axis. ``lengths``/``active`` are
+    HOST arrays: they change every step and ride into the jitted decode step
+    as small ``[num_slots]`` transfers, keeping every device program
+    fixed-shape.
+    """
+
+    def __init__(self, init_cache, num_slots: int, max_len: int, dtype=jnp.bfloat16):
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2 (prompt + one token), got {max_len}")
+        cache = init_cache(num_slots, max_len, dtype=dtype)
+        self.k, self.v = cache["k"], cache["v"]
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.dtype = dtype
+        self.lengths = np.zeros((num_slots,), np.int32)
+        self.active = np.zeros((num_slots,), bool)
+        self.allocator = SlotAllocator(num_slots)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.nbytes + self.v.nbytes)
+
+    @property
+    def occupancy(self) -> float:
+        return self.allocator.occupancy
+
+    def admit(self, length: int) -> Optional[int]:
+        """Claim a slot for a request whose cache currently holds ``length``
+        valid positions (the prefilled ``prompt[:-1]``)."""
+        slot = self.allocator.admit()
+        if slot is None:
+            return None
+        self.lengths[slot] = length
+        self.active[slot] = True
+        return slot
+
+    def retire(self, slot: int) -> None:
+        """Free ``slot``. No device work: stale K/V past a slot's length are
+        never readable (decode mask) and the next occupant's prefill insert
+        overwrites the prefix."""
+        self.allocator.retire(slot)
+        self.lengths[slot] = 0
+        self.active[slot] = False

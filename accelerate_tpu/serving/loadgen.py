@@ -1,0 +1,62 @@
+"""Offered-load generation for serving benchmarks.
+
+Replays a request trace against a :class:`~.engine.ServingEngine` at a fixed
+offered rate (requests/second, ``inf`` = all at once) with uniform arrival
+spacing, stepping the engine between arrivals. Shared by ``bench.py``'s
+``serving_`` section and the ``accelerate-tpu serve-bench`` CLI so the two
+can never measure differently.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+
+
+def make_prompts(
+    n: int, vocab_size: int, min_len: int, max_len: int, seed: int = 0
+) -> list[np.ndarray]:
+    """Deterministic mixed-length prompt trace (uniform lengths)."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(min_len, max_len + 1, n)
+    return [rng.integers(0, vocab_size, (int(s),)).astype(np.int32) for s in lens]
+
+
+def run_offered_load(
+    engine: ServingEngine,
+    prompts: Sequence[np.ndarray],
+    max_new_tokens: int,
+    offered_rps: float = math.inf,
+) -> dict:
+    """Submit ``prompts`` at ``offered_rps`` and drive the engine dry.
+
+    Returns the engine's :meth:`~.engine.ServingEngine.metrics` snapshot plus
+    the offered rate and completed-request count. A full queue defers the
+    arrival (re-checked after the next decode step) rather than dropping it,
+    and the submit is backdated to the INTENDED arrival time — the latency
+    cost of the backlog shows up in TTFT, which is the honest place for it.
+    """
+    arrivals = [0.0 if math.isinf(offered_rps) else i / offered_rps for i in range(len(prompts))]
+    t0 = time.perf_counter()
+    next_up = 0
+    completed = 0
+    while next_up < len(prompts) or engine.busy:
+        now = time.perf_counter() - t0
+        while next_up < len(prompts) and now >= arrivals[next_up] and engine.queue_available:
+            engine.submit(
+                prompts[next_up], max_new_tokens, submitted_at=t0 + arrivals[next_up]
+            )
+            next_up += 1
+        if engine.busy:
+            completed += len(engine.step())
+        elif next_up < len(prompts):
+            time.sleep(min(max(arrivals[next_up] - now, 0.0), 0.05))
+    out = engine.metrics()
+    out["offered_rps"] = None if math.isinf(offered_rps) else offered_rps
+    out["requests_completed"] = completed
+    return out

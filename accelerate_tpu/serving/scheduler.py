@@ -1,0 +1,134 @@
+"""Continuous-batching scheduler: request queue + slot lifecycle.
+
+Pure host-side policy — no jax in this module. The scheduler decides WHICH
+request occupies WHICH slot WHEN; the engine (``serving/engine.py``) turns
+those decisions into device work. Separation matters because policy wants to
+evolve (priorities, preemption, paging) without touching compiled programs.
+
+Lifecycle: ``submit`` (admission control on queue depth) → FIFO queue →
+``admit_ready`` moves requests into free slots as slots open → per-step the
+engine reports each slot's new token → ``retire`` frees the slot, which the
+very next ``admit_ready`` can hand to a queued request — finished requests
+never hold capacity for even one extra step.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the request queue is at ``max_queue`` depth."""
+
+
+@dataclass
+class Request:
+    """One serving request and its accumulated lifecycle state."""
+
+    id: int
+    prompt: np.ndarray  # [S] int32
+    max_new_tokens: int
+    submitted_at: float = field(default_factory=time.perf_counter)
+    # filled in as the request moves through the engine
+    slot: Optional[int] = None
+    prefill_bucket: Optional[int] = None
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    finish_reason: Optional[str] = None  # "eos" | "length"
+    generated: list[int] = field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+
+class ContinuousBatchingScheduler:
+    """FIFO queue in front of ``num_slots`` decode slots."""
+
+    def __init__(self, num_slots: int, max_queue: Optional[int] = None):
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        self.queue: deque[Request] = deque()
+        self.slots: list[Optional[Request]] = [None] * num_slots
+        self._ids = itertools.count()
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        request_id: Optional[int] = None,
+        submitted_at: Optional[float] = None,
+    ) -> Request:
+        """Enqueue a request. Raises :class:`QueueFull` past ``max_queue``
+        waiting requests — backpressure belongs at admission, not OOM.
+        ``submitted_at`` backdates the latency clock (deferred arrivals)."""
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                f"request queue is full ({len(self.queue)}/{self.max_queue} waiting)"
+            )
+        request = Request(
+            id=next(self._ids) if request_id is None else request_id,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+        )
+        if submitted_at is not None:
+            request.submitted_at = submitted_at
+        self.queue.append(request)
+        return request
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def admit_ready(self, free_slot) -> Iterator[tuple[int, Request]]:
+        """Pair queued requests with free slots, FIFO. ``free_slot`` is a
+        callable ``(request) -> slot index | None`` (the cache allocator,
+        which also records the request's prefilled length) — called once per
+        admitted request so cache and scheduler agree."""
+        while self.queue:
+            slot = free_slot(self.queue[0])
+            if slot is None:
+                return
+            request = self.queue.popleft()
+            request.slot = slot
+            request.admitted_at = time.perf_counter()
+            self.slots[slot] = request
+            yield slot, request
+
+    def retire(self, slot: int, reason: str) -> Request:
+        request = self.slots[slot]
+        if request is None:
+            raise ValueError(f"slot {slot} holds no request")
+        self.slots[slot] = None
+        request.finished_at = time.perf_counter()
+        request.finish_reason = reason
+        return request
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+    @property
+    def waiting(self) -> int:
+        return len(self.queue)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.slots)

@@ -12,6 +12,7 @@ from .goodput import GoodputTracker
 from .hub import Telemetry, TelemetryConfig
 from .memory import MemoryMonitor
 from .profiler import ProfileWindow
+from .serving import ServingStats
 from .step_timer import StepTimer, drain_local_devices
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "MemoryMonitor",
     "PEAK_BF16_FLOPS",
     "ProfileWindow",
+    "ServingStats",
     "StepTimer",
     "Telemetry",
     "TelemetryConfig",

@@ -256,6 +256,26 @@ class Telemetry:
                 accelerator.log(scalars, step=record["step"])
         return record
 
+    def write_record(self, kind: str, payload: dict) -> Optional[dict]:
+        """Append one non-step record (e.g. ``kind="serving"`` from a
+        ``ServingEngine``) to the jsonl sink. Local, NOT a collective —
+        payloads here are per-process observations, main process writes."""
+        if not self.enabled:
+            return None
+        from ..state import PartialState
+
+        state = PartialState()
+        record = {
+            "kind": kind,
+            "step": self.timer.steps,
+            "time": time.time(),
+            "process_index": state.process_index,
+            **payload,
+        }
+        if state.is_main_process:
+            self._write(record)
+        return record
+
     def _sink_path(self) -> str:
         directory = self.config.dir
         if directory is None and self.accelerator is not None:

@@ -1,0 +1,118 @@
+"""Serving-side telemetry: per-request latency and engine utilization.
+
+Training telemetry asks "where did the step time go"; serving telemetry asks
+the user-facing questions — *how long until the first token* (TTFT), *how
+fast do tokens stream after that* (per-token latency), and *how hard is the
+engine working* (throughput, slot occupancy, queue depth). One
+:class:`ServingStats` hangs off every ``ServingEngine``; the engine feeds it
+per step and per request, and ``snapshot()`` flattens to the same
+scalar-dict shape the hub's trackers and ``telemetry.jsonl`` expect.
+
+The decode step's host fetch (the engine reads each step's tokens to test
+EOS) doubles as the timing fence, so per-step durations here are real wall
+times — no extra synchronization is added to measure.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _percentiles_ms(samples: list[float], prefix: str, qs=(50, 90, 99)) -> dict:
+    if not samples:
+        return {}
+    arr = np.asarray(samples, np.float64) * 1e3
+    return {f"{prefix}_p{q}_ms": round(float(np.percentile(arr, q)), 3) for q in qs}
+
+
+class ServingStats:
+    """Accumulates engine-step and request-lifecycle samples."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self.started_at = time.perf_counter()
+        self.first_decode_at: Optional[float] = None
+        self.steps = 0
+        self.decode_seconds = 0.0
+        self.step_seconds: list[float] = []  # wall time per decode step
+        self.ttft_seconds: list[float] = []  # submit → first token, per request
+        self.latency_seconds: list[float] = []  # submit → finish, per request
+        self.tokens_generated = 0
+        self.prefill_tokens = 0
+        self.occupancy_sum = 0.0
+        self.queue_depth_sum = 0.0
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_rejected = 0
+        self.max_active = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def record_submit(self) -> None:
+        self.requests_submitted += 1
+
+    def record_reject(self) -> None:
+        self.requests_rejected += 1
+
+    def record_prefill(self, bucket: int) -> None:
+        self.prefill_tokens += bucket
+
+    def record_step(self, duration_s: float, active: int, waiting: int) -> None:
+        if self.first_decode_at is None:
+            self.first_decode_at = time.perf_counter() - duration_s
+        self.steps += 1
+        self.decode_seconds += duration_s
+        self.step_seconds.append(duration_s)
+        self.tokens_generated += active
+        self.occupancy_sum += active / self.num_slots
+        self.queue_depth_sum += waiting
+        self.max_active = max(self.max_active, active)
+
+    def record_first_token(self, ttft_s: float) -> None:
+        self.ttft_seconds.append(ttft_s)
+
+    def record_finish(self, latency_s: float) -> None:
+        self.requests_completed += 1
+        self.latency_seconds.append(latency_s)
+
+    # -- readout -----------------------------------------------------------
+
+    @property
+    def elapsed_seconds(self) -> float:
+        if self.first_decode_at is None:
+            return 0.0
+        return time.perf_counter() - self.first_decode_at
+
+    @property
+    def throughput_tokens_per_sec(self) -> float:
+        elapsed = self.elapsed_seconds
+        return self.tokens_generated / elapsed if elapsed > 0 else 0.0
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.occupancy_sum / self.steps if self.steps else 0.0
+
+    def snapshot(self) -> dict:
+        """Flat scalar metrics — the serving analogue of ``Telemetry.metrics``."""
+        out = {
+            "num_slots": self.num_slots,
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "prefill_tokens": self.prefill_tokens,
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "requests_rejected": self.requests_rejected,
+            "throughput_tokens_per_sec": round(self.throughput_tokens_per_sec, 3),
+            "slot_occupancy": round(self.mean_occupancy, 4),
+            "max_active_slots": self.max_active,
+        }
+        if self.steps:
+            out["queue_depth_mean"] = round(self.queue_depth_sum / self.steps, 3)
+            out["decode_seconds"] = round(self.decode_seconds, 4)
+        out.update(_percentiles_ms(self.step_seconds, "per_token"))
+        out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
+        out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
+        return out

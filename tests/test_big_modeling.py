@@ -142,17 +142,19 @@ def test_streamed_generate_matches_generate(tiny):
     np.testing.assert_array_equal(np.asarray(dev), expected)
 
 
-def test_generate_return_device_parity_and_eos_conflict(tiny):
+def test_generate_return_device_parity_and_eos(tiny):
     """return_device must yield the same ids as the host path (as a device
-    array), and combining it with eos truncation (host-side) must raise
-    rather than silently skip the truncation."""
+    array) — including with eos_token_id, whose done-mask now runs on device
+    so the two options compose instead of raising."""
     model, params, ids, _ = tiny
     host = generate(model, params, ids, max_new_tokens=4)
     dev = generate(model, params, ids, max_new_tokens=4, return_device=True)
     assert not isinstance(dev, np.ndarray)
     np.testing.assert_array_equal(np.asarray(dev), host)
-    with pytest.raises(ValueError, match="eos"):
-        generate(model, params, ids, max_new_tokens=4, return_device=True, eos_token_id=0)
+    host_eos = generate(model, params, ids, max_new_tokens=4, eos_token_id=0)
+    dev_eos = generate(model, params, ids, max_new_tokens=4, eos_token_id=0, return_device=True)
+    assert not isinstance(dev_eos, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(dev_eos), host_eos)
 
 
 def test_streaming_group_size_invariance(tiny):

@@ -178,6 +178,45 @@ def test_estimate_cli_from_config_json(tmp_path, capsys):
     assert "Config:" in out and "6.74B" in out and "int4" in out
 
 
+def test_estimate_cli_kv_cache_column(capsys):
+    """Serve sizing includes the KV cache: the registry path prints the
+    2·L·KV·D·S·B estimate and a +kv column driven by --max-seq-len/--batch."""
+    from accelerate_tpu.serving import kv_cache_bytes
+
+    args = argparse.Namespace(
+        model_name="llama-tiny", dtypes=["bfloat16"], max_seq_len=128, batch=4
+    )
+    assert run(args) == 0
+    out = capsys.readouterr().out
+    assert "KV cache (batch=4, seq=128)" in out and "+kv (serve)" in out
+    # the printed bf16 figure is the shared serving formula
+    from accelerate_tpu.models import get_config
+
+    expected = kv_cache_bytes(get_config("llama-tiny"), 4, 128, 2)
+    assert f"{expected / 1024:.2f} KB" in out or f"{expected / (1024 ** 2):.2f} MB" in out
+
+
+def test_estimate_cli_kv_cache_skipped_without_config(tmp_path, capsys):
+    """params=N has no geometry: the KV request is surfaced, not silent."""
+    args = argparse.Namespace(
+        model_name="params=1000000", dtypes=["bfloat16"], max_seq_len=256, batch=1
+    )
+    assert run(args) == 0
+    out = capsys.readouterr().out
+    assert "needs a model config" in out and "+kv (serve)" not in out
+
+
+def test_estimate_cli_kv_cache_skipped_for_uncovered_arch(capsys):
+    """The decoder-only formula must not print a wrong figure for t5 (per-
+    stack layers + cross-attention cache) — skip loudly instead."""
+    args = argparse.Namespace(
+        model_name="t5-base", dtypes=["bfloat16"], max_seq_len=512, batch=8
+    )
+    assert run(args) == 0
+    out = capsys.readouterr().out
+    assert "does not cover arch 't5'" in out and "+kv (serve)" not in out
+
+
 def test_estimate_cli_prefers_weights_over_config(tmp_path, capsys):
     """When real weights sit next to a config.json, headers win (exact for
     the stored dtypes, including quantized checkpoints)."""

@@ -1,0 +1,320 @@
+"""Continuous-batching serving engine: slot allocator, bucketed prefill,
+zero steady-state recompiles, and bit-exactness against sequential generate.
+
+All tier-1-fast on the CPU mesh — the engine's shapes never depend on the
+backend, so the compile/jit-cache invariants proven here are the TPU ones.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models import GPT2, Llama
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.serving import (
+    QueueFull,
+    ServingEngine,
+    SlotAllocator,
+    bucket_for,
+    kv_cache_bytes,
+    params_from_streamed,
+    prefill_buckets,
+    run_offered_load,
+)
+from accelerate_tpu.telemetry import CompileTracker
+
+
+@pytest.fixture(scope="module")
+def llama():
+    model = Llama("llama-tiny")
+    return model, model.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def gpt2():
+    model = GPT2("gpt2-tiny")
+    return model, model.init(jax.random.key(1))
+
+
+def _prompts(lengths, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (s,)).astype(np.int32) for s in lengths]
+
+
+# -- slot allocator -----------------------------------------------------------
+
+
+def test_slot_allocator_admit_retire_reuse():
+    alloc = SlotAllocator(3)
+    slots = [alloc.admit() for _ in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert alloc.admit() is None  # full
+    assert alloc.occupancy == 1.0
+    alloc.retire(slots[1])
+    assert alloc.free_count == 1
+    assert alloc.admit() == slots[1]  # immediate reuse of the freed slot
+    with pytest.raises(ValueError, match="not in use"):
+        alloc.retire(99)
+
+
+def test_prefill_bucket_set_is_logarithmic():
+    buckets = prefill_buckets(255)
+    assert buckets == (16, 32, 64, 128, 255)
+    assert bucket_for(1, buckets) == 16
+    assert bucket_for(16, buckets) == 16
+    assert bucket_for(17, buckets) == 32
+    assert bucket_for(255, buckets) == 255
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(256, buckets)
+    # tiny caches collapse to one bucket
+    assert prefill_buckets(8) == (8,)
+
+
+def test_kv_cache_bytes_formula():
+    from accelerate_tpu.models import get_config
+
+    cfg = get_config("llama-tiny")  # 2 layers, 2 kv heads, 32 dim/head
+    got = kv_cache_bytes(cfg, batch=4, max_seq_len=128, dtype_bytes=2)
+    assert got == 2 * 2 * 2 * 32 * 128 * 4 * 2
+
+
+# -- the acceptance invariants ------------------------------------------------
+
+
+def test_generate_many_matches_sequential_generate(llama):
+    """Mixed prompt lengths through the engine == per-request generate(),
+    bit-exact at temperature 0 — the continuous batching is invisible."""
+    model, params = llama
+    prompts = _prompts([3, 7, 12, 16])
+    engine = ServingEngine(model, params, num_slots=2, max_len=64, eos_token_id=5)
+    outs = engine.generate_many(prompts, max_new_tokens=6)
+    for prompt, out in zip(prompts, outs):
+        expected = generate(model, params, prompt[None], max_new_tokens=6, eos_token_id=5)[0]
+        np.testing.assert_array_equal(out, np.asarray(expected))
+
+
+def test_generate_many_matches_generate_gpt2(gpt2):
+    """Same invariant through a model-owned decode protocol (GPT2 methods)."""
+    model, params = gpt2
+    prompts = _prompts([4, 9, 14], seed=2)
+    engine = ServingEngine(model, params, num_slots=3, max_len=48)
+    outs = engine.generate_many(prompts, max_new_tokens=5)
+    for prompt, out in zip(prompts, outs):
+        expected = generate(model, params, prompt[None], max_new_tokens=5)[0]
+        np.testing.assert_array_equal(out, np.asarray(expected))
+
+
+def test_zero_steady_state_recompiles(llama):
+    """After warmup (one prefill+insert program per bucket + one decode
+    program), streaming requests with >= 4 distinct prompt lengths must
+    compile NOTHING and miss the jit cache NEVER."""
+    _, params = llama
+    model = Llama("llama-tiny")  # fresh instance: clean jit cache, order-independent counts
+    engine = ServingEngine(model, params, num_slots=4, max_len=64, buckets=(8, 16, 32))
+    tracker = CompileTracker().start()
+    engine.generate_many(_prompts([5, 13, 30], seed=3), max_new_tokens=3)  # warm every bucket
+    warm = tracker.snapshot()
+    # decode + 3 × (prefill, insert) = 7 programs, one warmup miss each
+    assert warm["jit_cache_misses"] == 7
+
+    for prompt in _prompts([3, 7, 9, 14, 17, 25, 31, 6, 12, 28], seed=4):
+        engine.submit(prompt, max_new_tokens=8)
+    engine.run()
+    steady = tracker.snapshot()
+    tracker.stop()
+    assert steady["compile_count"] == warm["compile_count"]
+    assert steady["jit_cache_misses"] == warm["jit_cache_misses"]
+    assert steady["jit_cache_hits"] > warm["jit_cache_hits"]
+
+
+# -- scheduling behavior ------------------------------------------------------
+
+
+def test_slot_contention_queues_and_reuses(llama):
+    """More requests than slots: the queue drains through retirement, every
+    request completes, and concurrency never exceeds the slot count."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    outs = engine.generate_many(_prompts([4, 6, 9], seed=5), max_new_tokens=4)
+    assert len(outs) == 3
+    assert engine.stats.requests_completed == 3
+    assert engine.stats.max_active == 1
+    # serially through one slot: one decode step per token
+    assert engine.stats.steps == 3 * 4
+
+
+def test_eos_retirement_frees_slot_next_step(llama):
+    """A request hitting EOS retires immediately: the slot serves the queue
+    on the very next step instead of idling to max_new_tokens."""
+    model, params = llama
+    prompt = _prompts([6], seed=6)[0]
+    # find the greedy continuation and use its second token as "EOS"
+    ref = np.asarray(generate(model, params, prompt[None], max_new_tokens=8))[0]
+    eos = int(ref[prompt.size + 1])
+    engine = ServingEngine(model, params, num_slots=1, max_len=64, eos_token_id=eos)
+    engine.submit(prompt, max_new_tokens=8)
+    engine.submit(_prompts([4], seed=7)[0], max_new_tokens=2)
+    results = engine.run()
+    first = results[0]
+    assert first.finish_reason == "eos"
+    assert len(first.generated) == 2  # stopped at the EOS hit, not at 8
+    assert first.generated[-1] == eos
+    assert results[1].finish_reason == "length"
+    # 2 steps for the eos request + 2 for the queued one
+    assert engine.stats.steps == 4
+
+
+def test_admission_control_queue_full(llama):
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32, max_queue=2)
+    engine.submit(_prompts([3])[0], max_new_tokens=2)
+    engine.submit(_prompts([3])[0], max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        engine.submit(_prompts([3])[0], max_new_tokens=2)
+    assert engine.stats.requests_rejected == 1
+    engine.run()
+
+
+def test_submit_validates_capacity(llama):
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="slot capacity"):
+        engine.submit(np.arange(10, dtype=np.int32), max_new_tokens=10)
+    with pytest.raises(ValueError, match="at least one token"):
+        engine.submit(np.zeros((0,), np.int32))
+    # single-token prompts skip prefill entirely
+    out = engine.generate_many([np.asarray([7], np.int32)], max_new_tokens=3)[0]
+    expected = generate(model, params, np.asarray([[7]], np.int32), max_new_tokens=3)[0]
+    np.testing.assert_array_equal(out, np.asarray(expected))
+
+
+# -- loaders ------------------------------------------------------------------
+
+
+def test_engine_from_streamed_int8(gpt2):
+    """int8 serving load path: dispatch_model's quantized host image →
+    on-device dequantized resident params → the engine, matching generate()
+    on the same dequantized weights exactly."""
+    from accelerate_tpu.big_modeling import dispatch_model, make_layered_device_map
+    from accelerate_tpu.utils.quantization import QuantizationConfig
+
+    model, params = gpt2
+    streamed = dispatch_model(
+        model, params, make_layered_device_map(model, "cpu"),
+        dtype=jnp.float32, quantization=QuantizationConfig(load_in_8bit=True),
+    )
+    qparams = params_from_streamed(streamed)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(qparams)):
+        assert a.shape == b.shape and b.dtype == jnp.float32
+    engine = ServingEngine.from_streamed(streamed, num_slots=2, max_len=48)
+    prompts = _prompts([5, 9], seed=8)
+    outs = engine.generate_many(prompts, max_new_tokens=4)
+    for prompt, out in zip(prompts, outs):
+        expected = generate(model, qparams, prompt[None], max_new_tokens=4)[0]
+        np.testing.assert_array_equal(out, np.asarray(expected))
+
+
+# -- telemetry ----------------------------------------------------------------
+
+
+def test_serving_stats_and_telemetry_record(llama, tmp_path):
+    from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+    model, params = llama
+    hub = Telemetry(config=TelemetryConfig(dir=str(tmp_path)))
+    engine = ServingEngine(model, params, num_slots=2, max_len=32, telemetry=hub)
+    engine.generate_many(_prompts([3, 5, 8], seed=9), max_new_tokens=4)
+    metrics = engine.metrics()
+    for key in (
+        "throughput_tokens_per_sec", "slot_occupancy", "ttft_p50_ms", "ttft_p99_ms",
+        "per_token_p50_ms", "per_token_p99_ms", "tokens_generated", "compile_count",
+        "jit_cache_hits",
+    ):
+        assert key in metrics, key
+    assert metrics["tokens_generated"] == 3 * 4
+    assert metrics["requests_completed"] == 3
+    assert 0 < metrics["slot_occupancy"] <= 1
+    record = engine.flush_telemetry()
+    assert record["kind"] == "serving"
+    hub.finish(flush=False)
+    lines = [json.loads(l) for l in open(tmp_path / "telemetry.jsonl")]
+    serving = [r for r in lines if r["kind"] == "serving"]
+    assert serving and serving[0]["serving"]["requests_completed"] == 3
+
+
+def test_run_offered_load_paced(llama):
+    """The load generator paces arrivals and reports the sweep-point shape
+    bench.py and serve-bench consume."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=2, max_len=32)
+    point = run_offered_load(engine, _prompts([3, 4, 5, 6], seed=10), 3, offered_rps=200.0)
+    assert point["requests_completed"] == 4
+    assert point["offered_rps"] == 200.0
+    assert point["tokens_generated"] == 4 * 3
+
+
+def test_run_offered_load_backpressure_counts_in_ttft(llama):
+    """A bounded queue under saturation defers arrivals instead of dropping
+    or re-rejecting them: everything completes, zero rejects are recorded,
+    and the deferred requests' TTFT includes the backlog wait (backdated
+    submit), so the tail TTFT strictly exceeds the unqueued one."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32, max_queue=1)
+    point = run_offered_load(engine, _prompts([4, 5, 6, 7], seed=14), 4)
+    assert point["requests_completed"] == 4
+    assert point["requests_rejected"] == 0
+    # last-admitted request waited for ~3 predecessors × 4 decode steps
+    assert point["ttft_p99_ms"] > point["ttft_p50_ms"]
+
+
+def test_engine_warmup_compiles_every_bucket(llama):
+    """warmup() deterministically compiles one (prefill, insert) pair per
+    bucket + the decode step; any traffic mix afterwards compiles nothing."""
+    _, params = llama
+    model = Llama("llama-tiny")  # fresh jit cache
+    engine = ServingEngine(model, params, num_slots=2, max_len=64, buckets=(8, 16, 32))
+    tracker = CompileTracker().start()
+    engine.warmup()
+    warm = tracker.snapshot()
+    assert warm["jit_cache_misses"] == 7  # decode + 3 × (prefill, insert)
+    engine.generate_many(_prompts([3, 9, 20, 31], seed=13), max_new_tokens=4)
+    steady = tracker.snapshot()
+    tracker.stop()
+    assert steady["compile_count"] == warm["compile_count"]
+    assert steady["jit_cache_misses"] == warm["jit_cache_misses"]
+
+
+# -- generation satellites (device-side EOS mask) -----------------------------
+
+
+def test_generate_eos_with_return_device(llama):
+    """eos_token_id now composes with return_device: the done-mask runs on
+    device, so the returned device array is already EOS-filled."""
+    model, params = llama
+    ids = _prompts([5], seed=11)[0][None]
+    host = generate(model, params, ids, max_new_tokens=6, eos_token_id=5)
+    dev = generate(model, params, ids, max_new_tokens=6, eos_token_id=5, return_device=True)
+    assert not isinstance(dev, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+
+
+def test_generate_done_mask_matches_host_truncation_semantics(llama):
+    """Pick an EOS id that the greedy run actually emits mid-stream: output
+    before the first EOS is unchanged, everything after is EOS — exactly the
+    old host-side truncation, now produced on device."""
+    model, params = llama
+    ids = _prompts([4, 6], seed=12)
+    batch = np.stack([np.pad(p, (0, 6 - p.size)) for p in ids])[:, :4].astype(np.int32)
+    free = np.asarray(generate(model, params, batch, max_new_tokens=8))
+    eos = int(free[0, 4 + 2])  # third generated token of row 0
+    with_eos = np.asarray(generate(model, params, batch, max_new_tokens=8, eos_token_id=eos))
+    expected = free.copy()
+    for row in range(expected.shape[0]):
+        hits = np.where(expected[row, 4:] == eos)[0]
+        if hits.size:
+            expected[row, 4 + hits[0] + 1 :] = eos
+    np.testing.assert_array_equal(with_eos, expected)
