@@ -43,6 +43,8 @@ from .logging import get_logger
 from .optimizer import AcceleratedOptimizer, clip_by_global_norm, clip_by_value, scaled_optimizer_update
 from .ops import operations as ops
 from .parallel.sharding import PartitionRules, infer_shardings, replicated, shard_tree
+from .resilience import Resilience, ResilienceConfig
+from .resilience.guards import next_guard_state, zero_guard_state
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .state import distributed_is_initialized as _distributed_is_initialized
@@ -162,6 +164,7 @@ class Accelerator:
         log_with: Optional[list] = None,
         kwargs_handlers: Optional[list[KwargsHandler]] = None,
         telemetry_config: Optional[TelemetryConfig] = None,
+        resilience_config: Optional[ResilienceConfig] = None,
     ):
         # -- plugin / parallelism resolution (reference accelerator.py:285-335)
         if model_parallel_plugin is not None and parallelism is None:
@@ -291,6 +294,12 @@ class Accelerator:
         # until the user calls telemetry.step()/flush().
         self.telemetry = Telemetry(accelerator=self, config=telemetry_config)
         self._profile_active = False
+        # -- resilience hub (resilience/hub.py): numerical guards fused into
+        # compiled_step, the chaos fault-injection harness, and retry
+        # observability. Inert (and compiled programs unchanged) unless a
+        # config is passed or ACCELERATE_RESILIENCE / ACCELERATE_CHAOS_* is
+        # set — constructed after telemetry so its records have a sink.
+        self.resilience = Resilience(accelerator=self, config=resilience_config)
         if self.telemetry.enabled:
             import weakref
 
@@ -982,7 +991,7 @@ class Accelerator:
             # (~0.9 GB on bert-base ≈ 3 ms — the round-2..4 bert regression)
             return loss if scale is None else loss * scale
 
-        def step_impl(params, opt_state, batch, scale, growth_tracker):
+        def loss_and_grads(params, batch, scale):
             if num_micro > 1:
                 def micro(carry, mb):
                     grads_acc, loss_acc = carry
@@ -996,8 +1005,23 @@ class Accelerator:
                 (grads, loss), _ = jax.lax.scan(micro, (zeros, jnp.float32(0.0)), micro_batches)
                 grads = jax.tree.map(lambda g: g / num_micro, grads)
                 loss = loss / num_micro
-            else:
-                loss, grads = jax.value_and_grad(loss_of)(params, batch, scale)
+                return loss, grads
+            return jax.value_and_grad(loss_of)(params, batch, scale)
+
+        # -- resilience (resilience/): when the hub is armed, the numerical
+        # guard's finite verdict + skip/escalate policy fuse into the program
+        # and the chaos harness can poison loss/grads at scheduled steps.
+        # With the hub inert (the default) the plain program below is built
+        # unchanged — zero cost, bit-identical behavior.
+        resilience = getattr(self, "resilience", None)
+        res_on = resilience is not None and resilience.enabled
+        guard = resilience.guard if res_on else None
+        gpolicy = guard.policy if guard is not None else None
+        chaos = resilience.chaos if res_on else None
+        chaos_nan = bool(chaos is not None and chaos.nan_steps)
+
+        def step_impl(params, opt_state, batch, scale, growth_tracker):
+            loss, grads = loss_and_grads(params, batch, scale)
             if scale is not None:
                 grads = jax.tree.map(lambda g: g / scale, grads)
             grads = clip_by_value(grads, clip_grad_value)
@@ -1021,7 +1045,68 @@ class Accelerator:
             opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
             return params, opt_state, loss, scale, growth_tracker, skipped
 
-        jitted = jax.jit(step_impl, donate_argnums=(0, 1))
+        def guarded_step_impl(params, opt_state, batch, scale, growth_tracker, gstate, corrupt):
+            loss, grads = loss_and_grads(params, batch, scale)
+            if chaos_nan:
+                # scheduled poisoning lands where a real blowup would: in the
+                # traced program, before the guard's verdict
+                poison = jnp.where(corrupt != 0, jnp.float32(jnp.nan), jnp.float32(1.0))
+                if chaos.nan_target == "loss":
+                    loss = loss * poison
+                else:
+                    grads = jax.tree.map(lambda g: g * poison, grads)
+            if scale is not None:
+                grads = jax.tree.map(lambda g: g / scale, grads)
+            grads = clip_by_value(grads, clip_grad_value)
+            # the guard's verdict needs the global norm regardless of clip
+            # settings — one reduction covers every gradient leaf
+            grads, gnorm = clip_by_global_norm(grads, None)
+            finite = jnp.isfinite(loss) & jnp.isfinite(gnorm) if guard is not None else None
+            escalating = guard is not None and gpolicy.escalate_clip is not None
+            if clip_grad_norm is not None or escalating:
+                base = (
+                    jnp.float32(clip_grad_norm)
+                    if clip_grad_norm is not None
+                    else jnp.float32(jnp.inf)
+                )
+                if escalating:
+                    # for escalate_steps after a bad step the clip tightens
+                    esc = jnp.minimum(jnp.float32(gpolicy.escalate_clip), base)
+                    limit = jnp.where(gstate["escalate"] > 0, esc, base)
+                else:
+                    limit = base
+                factor = jnp.minimum(1.0, limit / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            if scale is not None:
+                loss = loss / scale
+            if guard is not None and gpolicy.skip_nonfinite:
+                def _apply(args):
+                    p, o, s, gt = args
+                    return scaled_optimizer_update(tx, p, o, grads, gnorm, s, gt, scaler_cfg)
+
+                def _skip(args):
+                    p, o, s, gt = args
+                    if scaler_cfg is not None:
+                        # a guard skip IS the overflow case the scaler's
+                        # backoff exists for — keep its dynamics intact
+                        s = s * scaler_cfg.backoff_factor
+                        gt = jnp.int32(0)
+                    return p, o, s, gt, jnp.asarray(True)
+
+                params, opt_state, scale, growth_tracker, skipped = jax.lax.cond(
+                    finite, _apply, _skip, (params, opt_state, scale, growth_tracker)
+                )
+            else:
+                params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
+                    tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
+                )
+            if guard is not None:
+                gstate = next_guard_state(gstate, finite, gpolicy.escalate_steps)
+            params = jax.lax.with_sharding_constraint(params, model.params_shardings)
+            opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
+            return params, opt_state, loss, scale, growth_tracker, skipped, gstate
+
+        jitted = jax.jit(guarded_step_impl if res_on else step_impl, donate_argnums=(0, 1))
 
         def step(batch):
             # no scaler → scale stays a STATIC None (empty pytree through jit):
@@ -1032,9 +1117,23 @@ class Accelerator:
             opt_state_in = optimizer.opt_state
             if optimizer.cpu_offload:
                 opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)
-            params, opt_state, loss, scale, growth, skipped = jitted(
-                model.params, opt_state_in, batch, scale, growth
-            )
+            if res_on:
+                step_idx = resilience.begin_step()  # chaos stall/SIGTERM fire here
+                corrupt = np.int32(0)
+                if chaos_nan and chaos.corrupt_target(step_idx) is not None:
+                    corrupt = np.int32(1)
+                if guard is not None and guard.state is None:
+                    guard.arm(model, optimizer)
+                gstate_in = guard.state if guard is not None else zero_guard_state()
+                params, opt_state, loss, scale, growth, skipped, gstate_out = jitted(
+                    model.params, opt_state_in, batch, scale, growth, gstate_in, corrupt
+                )
+                if guard is not None:
+                    guard.state = gstate_out
+            else:
+                params, opt_state, loss, scale, growth, skipped = jitted(
+                    model.params, opt_state_in, batch, scale, growth
+                )
             model.params = params
             optimizer.opt_state = opt_state
             if optimizer.cpu_offload:
@@ -1047,6 +1146,10 @@ class Accelerator:
             optimizer._step_count += 1
             if optimizer.telemetry is not None:
                 optimizer.telemetry._on_optimizer_step()
+            if guard is not None:
+                # fence-cadence host check: snapshot refresh / LKG restore.
+                # Off the cadence this is two integer ops — no host sync.
+                guard.after_step(model, optimizer)
             return loss
 
         return step
@@ -1195,9 +1298,11 @@ class Accelerator:
                 tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 
     def end_training(self) -> None:
-        # telemetry first: its final flush fans out through the trackers
-        # below. Collective when multi-host (like this method generally:
-        # call end_training on every process).
+        # resilience first (its final guard check + summary record must land
+        # before the telemetry sink closes), then telemetry's final flush
+        # fans out through the trackers below. Collective when multi-host
+        # (like this method generally: call end_training on every process).
+        self.resilience.finish()
         self.telemetry.finish()
         for tracker in self.trackers:
             tracker.finish()

@@ -555,6 +555,11 @@ def load_accelerator_state(
             raise FileNotFoundError(f"No checkpoints under {base}")
         input_dir = checkpoints[-1]
     logger.info(f"Loading states from {input_dir}")
+    # chaos harness: an injected transient EIO here rides the caller's retry
+    # policy (CheckpointManager.resume wraps single-process loads)
+    from .resilience.chaos import probe_io as _chaos_probe_io
+
+    _chaos_probe_io("checkpoint_load")
 
     for hook in accelerator._load_model_hooks:
         hook(accelerator._models, input_dir)

@@ -27,6 +27,15 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
+
+from ..resilience.retry import RetryPolicy
+
+# Backoff between fleet relaunches: an immediate restart after an infra
+# failure (TPU runtime crash, zone-wide ssh blip) usually hits the same
+# failure again within seconds, burning the restart budget on nothing. The
+# jitter also decorrelates supervisors restarting against a shared outage.
+RESTART_POLICY = RetryPolicy(base_delay=1.0, max_delay=30.0, jitter=0.25)
 
 
 def register_subcommand(subparsers):
@@ -163,6 +172,7 @@ def supervise(
     restarts: int = 0,
     heartbeat_timeout: float = 0.0,
     poll_interval: float = 1.0,
+    restart_policy: Optional[RetryPolicy] = None,
 ) -> int:
     """Run ``spawn(i) -> Popen`` for every worker and monitor the fleet.
 
@@ -177,8 +187,15 @@ def supervise(
     attempts then get a different command — the auto-resume path appends
     ``--resume auto`` from attempt 2 on, so a restarted fleet continues from
     the newest valid checkpoint instead of step 0.
+
+    Relaunches back off under ``restart_policy`` (default
+    :data:`RESTART_POLICY`: jittered exponential, 1 s base, 30 s cap) instead
+    of restarting immediately — attempt N sleeps ``delay_for(N-1)`` first.
     """
     import inspect
+
+    if restart_policy is None:
+        restart_policy = RESTART_POLICY
 
     try:
         spawn_takes_attempt = len(inspect.signature(spawn).parameters) >= 2
@@ -221,11 +238,13 @@ def supervise(
         )
         if attempt > restarts:
             return failed[1]
+        delay = restart_policy.delay_for(attempt - 1)
         print(
-            f"pod-launch: restarting the whole job "
+            f"pod-launch: restarting the whole job in {delay:.1f}s "
             f"(attempt {attempt + 1}/{restarts + 1})",
             file=sys.stderr,
         )
+        restart_policy.sleep(delay)
 
 
 def run(args) -> int:

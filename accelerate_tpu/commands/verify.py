@@ -1,45 +1,119 @@
-"""`accelerate-tpu verify-checkpoint <dir>` — offline checkpoint validation.
+"""`accelerate-tpu verify-checkpoint <dir>` — offline checkpoint validation
+and repair.
 
 Validates a checkpoint directory against its ``manifest.json`` (completeness,
 per-file sizes, CRC32 checksums) without touching an accelerator: the CI/ops
-counterpart of the commit protocol in ``fault_tolerance.py``. Exit code 0
-means the checkpoint is complete and resumable; 1 lists every problem found.
+counterpart of the commit protocol in ``fault_tolerance.py``. ``<dir>`` may be
+one checkpoint (it contains a manifest) or a checkpoints base directory (every
+``checkpoint_<n>`` child is verified). Exit code 0 means everything verified
+is complete and resumable; 1 lists every problem found.
+
+``--repair`` turns report-only into cleanup: torn ``*.tmp`` staging dirs are
+garbage-collected and checkpoints whose manifest fails verification are
+pruned (auto-resume already skips them — pruning reclaims the space and keeps
+`latest_valid` scans fast), printing exactly what was removed. The newest
+valid checkpoint is never touched.
 """
 
 from __future__ import annotations
 
+import os
+import shutil
 import sys
 
 
 def register_subcommand(subparsers):
     parser = subparsers.add_parser(
         "verify-checkpoint",
-        help="Validate a checkpoint directory's manifest offline (sizes + checksums)",
+        help="Validate (and optionally repair) checkpoint dirs offline (sizes + checksums)",
     )
-    parser.add_argument("checkpoint_dir", help="Checkpoint directory (contains manifest.json)")
+    parser.add_argument(
+        "checkpoint_dir",
+        help="One checkpoint directory (contains manifest.json) or a base dir of checkpoint_<n> dirs",
+    )
     parser.add_argument(
         "--no-checksums",
         action="store_true",
         help="Skip CRC32 verification (sizes/completeness only — fast on huge checkpoints)",
     )
+    parser.add_argument(
+        "--repair",
+        action="store_true",
+        help="GC torn *.tmp staging dirs and prune checkpoints that fail verification "
+        "(prints what was removed)",
+    )
     parser.set_defaults(func=run)
     return parser
 
 
-def run(args) -> int:
+def _verify_one(directory: str, check_checksums: bool, problems=None) -> int:
     from ..fault_tolerance import read_manifest, verify_checkpoint
 
-    problems = verify_checkpoint(args.checkpoint_dir, check_checksums=not args.no_checksums)
+    if problems is None:
+        problems = verify_checkpoint(directory, check_checksums=check_checksums)
     if problems:
         for problem in problems:
-            print(f"FAIL {args.checkpoint_dir}: {problem}", file=sys.stderr)
+            print(f"FAIL {directory}: {problem}", file=sys.stderr)
         return 1
-    manifest = read_manifest(args.checkpoint_dir) or {}
+    manifest = read_manifest(directory) or {}
     files = manifest.get("files", {})
     total = sum(meta.get("size", 0) for meta in files.values())
     step = manifest.get("step")
     detail = f"{len(files)} files, {total / 2**20:.1f} MiB"
     if step is not None:
         detail += f", step {step}"
-    print(f"OK {args.checkpoint_dir}: {detail}")
+    print(f"OK {directory}: {detail}")
     return 0
+
+
+def run(args) -> int:
+    from ..fault_tolerance import (
+        garbage_collect_torn,
+        list_checkpoints,
+        verify_checkpoint,
+    )
+    from ..utils.constants import CHECKPOINT_MANIFEST_NAME
+
+    base = args.checkpoint_dir
+    check = not args.no_checksums
+    is_single = os.path.exists(os.path.join(base, CHECKPOINT_MANIFEST_NAME))
+    targets = [base] if is_single else list_checkpoints(base)
+
+    verified_clean = False
+    if args.repair:
+        removed = []
+        # torn staging debris first (never shadows valid checkpoints, but
+        # wastes space and confuses `ls`-level ops). abspath: a relative
+        # single-checkpoint arg must not dirname down to "" and skip the GC
+        gc_base = (
+            os.path.dirname(os.path.abspath(base.rstrip(os.sep))) if is_single else base
+        )
+        removed += garbage_collect_torn(gc_base)
+        pruned = []
+        for path in targets:
+            problems = verify_checkpoint(path, check_checksums=check)
+            if problems:
+                shutil.rmtree(path, ignore_errors=True)
+                pruned.append((path, problems))
+        for path in removed:
+            print(f"REMOVED torn staging dir {path}")
+        for path, problems in pruned:
+            print(f"PRUNED invalid checkpoint {path}: {problems[0]}"
+                  + (f" (+{len(problems) - 1} more)" if len(problems) > 1 else ""))
+        if not removed and not pruned:
+            print(f"REPAIR {base}: nothing to remove")
+        doomed = {path for path, _ in pruned}
+        targets = [path for path in targets if path not in doomed]
+        verified_clean = True  # every survivor passed the repair pass's verify
+
+    if not targets:
+        if args.repair:
+            return 0
+        print(f"FAIL {base}: no checkpoints found", file=sys.stderr)
+        return 1
+    worst = 0
+    for path in targets:
+        # after a repair pass, survivors verified clean moments ago — report
+        # without re-reading (CRC'ing multi-GB checkpoints twice is real I/O)
+        worst = max(worst, _verify_one(path, check, problems=[] if verified_clean else None))
+    return worst

@@ -32,10 +32,18 @@ import numpy as np
 import jax
 
 from .logging import get_logger
+from .resilience.retry import DEFAULT_IO_RETRY
 from .state import GradientState, PartialState
 from .ops.operations import broadcast_object_list, concatenate, find_batch_size, recursively_apply
 
 logger = get_logger(__name__)
+
+# Transient-I/O policy for map-style batch fetches: datasets reading off
+# GCS-fuse/NFS drop rows with EIO/ESTALE weather exactly like checkpoint
+# writes do, and re-indexing a map-style dataset is idempotent — so the fetch
+# retries under the stack-wide policy instead of killing the epoch.
+# (Iterable datasets cannot be retried: a generator that raised is spent.)
+io_retry_policy = DEFAULT_IO_RETRY
 
 
 # ---------------------------------------------------------------------------
@@ -503,9 +511,12 @@ class DataLoaderShard(BaseDataLoader):
     def __len__(self) -> int:
         return len(self.batch_sampler)
 
+    def _fetch_batch(self, index_batch):
+        return self.collate_fn([self.dataset[i] for i in index_batch])
+
     def _local_batches(self):
         for index_batch in self.batch_sampler:
-            yield self.collate_fn([self.dataset[i] for i in index_batch])
+            yield io_retry_policy.call(self._fetch_batch, index_batch)
 
     def __iter__(self):
         yield from self._iterate_with_lookahead(self._local_batches())

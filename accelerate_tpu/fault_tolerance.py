@@ -57,6 +57,8 @@ from .utils.constants import (
     CHECKPOINT_MANIFEST_NAME,
     CHECKPOINT_TMP_SUFFIX,
 )
+from .resilience.chaos import probe_io as _chaos_probe_io
+from .resilience.retry import DEFAULT_IO_RETRY, RetryPolicy
 from .utils.memory import retry_transient_io
 
 logger = get_logger(__name__)
@@ -123,6 +125,7 @@ def build_manifest(directory: str, step: Optional[int] = None, metadata: Optiona
 def write_manifest(directory: str, manifest: dict) -> str:
     """Durably write ``manifest.json`` (fsync'd: the rename that follows must
     never promote a dir whose manifest is still in the page cache)."""
+    _chaos_probe_io("checkpoint_save")  # chaos harness: injected EIO rides the retry above
     path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
     tmp = path + ".part"
     with open(tmp, "w") as f:
@@ -326,6 +329,7 @@ class CheckpointManager:
         handle_signals: tuple = (signal.SIGTERM, signal.SIGINT),
         check_checksums: bool = True,
         preemption_sync_every: int = 1,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.accelerator = accelerator
         project = accelerator.project_configuration
@@ -349,6 +353,9 @@ class CheckpointManager:
         # values amortize the allgather on big pods — keep it well under the
         # grace window in steps. Single-host runs never pay a collective.
         self.preemption_sync_every = max(int(preemption_sync_every), 1)
+        # jittered-backoff policy for whole-call save/load retries (the
+        # per-operation commit-protocol retries keep their own wrapping)
+        self.retry_policy = retry_policy if retry_policy is not None else DEFAULT_IO_RETRY
         self._preempted = False
         self._preempt_signum: Optional[int] = None
         self._saved_on_preemption = False
@@ -489,7 +496,7 @@ class CheckpointManager:
         # (write_manifest / commit_checkpoint).
         save = self.accelerator.save_state
         if state.num_processes == 1:
-            save = retry_transient_io(save)
+            save = self.retry_policy.wrap(save)
         with self._telemetry_pause("checkpoint_save"):
             save(target, sharded=self.sharded, manifest_metadata=meta)
         # collective check, not the host-local flag: the signal landed on one
@@ -554,7 +561,7 @@ class CheckpointManager:
         # same single-process-only whole-call retry rationale as save()
         load = self.accelerator.load_state
         if PartialState().num_processes == 1:
-            load = retry_transient_io(load)
+            load = self.retry_policy.wrap(load)
         with self._telemetry_pause("checkpoint_restore"):
             load(path)
         telemetry = getattr(self.accelerator, "telemetry", None)

@@ -1,0 +1,38 @@
+"""Resilience subsystem: fault injection, numerical guards, unified retries.
+
+PR 1 made crashes survivable at checkpoint granularity and PR 2 made runs
+observable; this package defends the steps *between* checkpoints and the
+serving path. Four legs (see docs/resilience.md):
+
+- :mod:`~.chaos` — a seeded, deterministic :class:`FaultPlan` that injects
+  NaNs, transient I/O errors, stalls, SIGTERM, and serving queue bursts —
+  the harness every other leg is proven against on CPU;
+- :mod:`~.guards` — device-side all-finite checks fused into the train step
+  (:class:`GuardPolicy`: skip-and-log, escalating grad-clip, last-known-good
+  restore) with zero steady-state host syncs beyond the telemetry fence;
+- serving degradation — deadlines, cancellation, load shedding with
+  ``retry_after``, slot quarantine (lives in ``serving/``, driven from here);
+- :mod:`~.retry` — one jittered-exponential-backoff :class:`RetryPolicy`
+  consumed by checkpointing, the streamed big-model load path, the data
+  loader, and pod-launch relaunches.
+
+Everything reports through the Telemetry hub as ``{"kind": "resilience"}``
+records in ``telemetry.jsonl``.
+"""
+
+from .chaos import FaultPlan
+from .guards import GuardPolicy, NumericalGuard, tree_all_finite, zero_guard_state
+from .hub import Resilience, ResilienceConfig
+from .retry import DEFAULT_IO_RETRY, RetryPolicy
+
+__all__ = [
+    "DEFAULT_IO_RETRY",
+    "FaultPlan",
+    "GuardPolicy",
+    "NumericalGuard",
+    "Resilience",
+    "ResilienceConfig",
+    "RetryPolicy",
+    "tree_all_finite",
+    "zero_guard_state",
+]

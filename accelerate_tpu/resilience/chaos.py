@@ -1,0 +1,189 @@
+"""Deterministic fault injection — the chaos harness the resilience legs are
+proven against.
+
+A :class:`FaultPlan` is a *schedule*, not a dice roll: given the same plan,
+the same faults land at the same steps every run, so a CPU test can assert
+"2 NaN steps + 1 transient save failure + 1 SIGTERM" down to the exact
+telemetry records. The seed only feeds synthetic content (burst prompts),
+never *whether* a fault fires.
+
+Fault legs:
+
+- ``nan_steps`` / ``nan_target`` — poison the loss or the gradients of the
+  chosen training steps (device-side, inside the jitted step — the guard
+  must catch it where it would really appear, not in a host mock);
+- ``io_failures`` — the first N checkpoint save/load I/O probes raise a
+  transient ``EIO``; the commit protocol's retry policy must ride them out;
+- ``stall_steps`` — artificial host stalls (slow-collective / straggler
+  weather) of ``stall_seconds`` each;
+- ``sigterm_step`` — a real ``SIGTERM`` to this process at the chosen step
+  (the spot-VM preemption drill; ``CheckpointManager`` must boundary-save);
+- ``serving_burst_step`` / ``serving_burst_size`` — a burst of synthetic
+  requests pushed straight into a ``ServingEngine``'s queue (bypassing
+  admission control, so the pressure is real) to force shedding.
+
+Activation: pass a plan to ``ResilienceConfig(fault_plan=...)`` /
+``ServingEngine(fault_plan=...)``, or export ``ACCELERATE_CHAOS_*`` (see
+:meth:`FaultPlan.from_env`) to arm a whole unmodified training script.
+Module-level ``activate()`` registers the plan for call sites that have no
+constructor plumbing (the checkpoint I/O probes in fault_tolerance).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _parse_steps(value: Optional[str]) -> tuple[int, ...]:
+    if not value:
+        return ()
+    return tuple(int(v) for v in value.replace(" ", "").split(",") if v)
+
+
+@dataclass
+class FaultPlan:
+    """One run's deterministic fault schedule plus the ledger of what fired.
+
+    Training-step indices are 1-based counts of ``compiled_step`` invocations
+    on the owning Accelerator; serving-step indices count ``ServingEngine``
+    decode steps (``engine._steps`` BEFORE the step runs, i.e. 0-based).
+    """
+
+    seed: int = 0
+    nan_steps: tuple[int, ...] = ()
+    nan_target: str = "grads"  # "grads" | "loss"
+    io_failures: int = 0
+    stall_steps: tuple[int, ...] = ()
+    stall_seconds: float = 0.05
+    sigterm_step: Optional[int] = None
+    serving_burst_step: Optional[int] = None
+    serving_burst_size: int = 0
+
+    # ledger of injected faults (appended in firing order); ``sink`` is set by
+    # the resilience hub so every injection also lands in telemetry.jsonl
+    events: list = field(default_factory=list)
+    sink: Optional[Callable[[dict], None]] = field(default=None, repr=False)
+    _io_injected: int = field(default=0, repr=False)
+    _sigterm_fired: bool = field(default=False, repr=False)
+
+    def __post_init__(self):
+        if self.nan_target not in ("grads", "loss"):
+            raise ValueError(f"nan_target must be 'grads' or 'loss', got {self.nan_target!r}")
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """Build a plan from ``ACCELERATE_CHAOS_*`` env vars; None when no
+        chaos var is set (the common case — zero overhead)."""
+        env = os.environ
+        if not any(k.startswith("ACCELERATE_CHAOS_") for k in env):
+            return None
+        sigterm = env.get("ACCELERATE_CHAOS_SIGTERM_STEP")
+        burst_step = env.get("ACCELERATE_CHAOS_SERVING_BURST_STEP")
+        return cls(
+            seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
+            nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
+            nan_target=env.get("ACCELERATE_CHAOS_NAN_TARGET", "grads"),
+            io_failures=int(env.get("ACCELERATE_CHAOS_IO_FAILURES", "0")),
+            stall_steps=_parse_steps(env.get("ACCELERATE_CHAOS_STALL_STEPS")),
+            stall_seconds=float(env.get("ACCELERATE_CHAOS_STALL_SECONDS", "0.05")),
+            sigterm_step=int(sigterm) if sigterm else None,
+            serving_burst_step=int(burst_step) if burst_step else None,
+            serving_burst_size=int(env.get("ACCELERATE_CHAOS_SERVING_BURST_SIZE", "0")),
+        )
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self.nan_steps
+            or self.io_failures
+            or self.stall_steps
+            or self.sigterm_step is not None
+            or self.serving_burst_size
+        )
+
+    def _record(self, fault: str, **detail) -> None:
+        event = {"event": "fault_injected", "fault": fault, **detail}
+        self.events.append(event)
+        logger.warning(f"chaos: injected {fault} ({detail})")
+        if self.sink is not None:
+            try:
+                self.sink(event)
+            except Exception:  # noqa: BLE001 - chaos must not break the run twice
+                pass
+
+    # -- training-side hooks (driven by the resilience hub per step) --------
+
+    def on_step(self, step: int) -> None:
+        """Host-side faults at the START of training step ``step``: stalls
+        and the (single) SIGTERM."""
+        if step in self.stall_steps:
+            self._record("stall", step=step, seconds=self.stall_seconds)
+            time.sleep(self.stall_seconds)
+        if self.sigterm_step == step and not self._sigterm_fired:
+            self._sigterm_fired = True
+            self._record("sigterm", step=step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def corrupt_target(self, step: int) -> Optional[str]:
+        """Which tensor (if any) to poison with NaN this step."""
+        if step in self.nan_steps:
+            self._record("nan", step=step, target=self.nan_target)
+            return self.nan_target
+        return None
+
+    # -- I/O-side hook (checkpoint save/load probes) ------------------------
+
+    def probe_io(self, site: str) -> None:
+        """Raise a *transient* I/O error while the injection budget lasts —
+        the retry policy downstream is expected to absorb it."""
+        if self._io_injected < self.io_failures:
+            self._io_injected += 1
+            self._record("io_error", site=site, index=self._io_injected)
+            raise OSError(errno.EIO, f"chaos: injected transient I/O error at {site}")
+
+    # -- serving-side hook --------------------------------------------------
+
+    def serving_burst(self, engine_step: int) -> int:
+        """Synthetic requests to force-inject before this engine step."""
+        if self.serving_burst_step == engine_step and self.serving_burst_size:
+            self._record("serving_burst", step=engine_step, size=self.serving_burst_size)
+            return self.serving_burst_size
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# module-level activation (for call sites without constructor plumbing)
+# ---------------------------------------------------------------------------
+
+_active: Optional[FaultPlan] = None
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    global _active
+    _active = plan
+    return plan
+
+
+def deactivate() -> None:
+    global _active
+    _active = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def probe_io(site: str) -> None:
+    """Checkpoint save/load call sites probe here; a no-op unless a plan with
+    I/O budget is active (one attribute read on the common path)."""
+    if _active is not None:
+        _active.probe_io(site)

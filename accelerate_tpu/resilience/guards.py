@@ -1,0 +1,218 @@
+"""Numerical guards: device-side all-finite checks fused into the train step.
+
+A NaN/Inf blowup between checkpoints is the one failure PR 1's machinery
+cannot help with — the poison propagates into params within one step, and
+every later checkpoint is corrupt. The guard closes that hole with GSPMD
+economics: under single-program SPMD the whole step is one traced program,
+so the verdict is two scalar ``isfinite`` ops fused where the data already
+is (the loss, and the gradients' global norm the clip computes anyway) —
+not a host-side tree walk.
+
+Steady-state discipline mirrors ``telemetry/step_timer.py``: the verdict
+and the policy's counters live ON DEVICE (a 3-scalar int32 state threaded
+through the jitted step), and the host reads them only every
+``check_every`` steps — the same cadence the telemetry StepTimer already
+fences on. Guards therefore add ZERO host syncs beyond the existing fence
+cadence; the acceptance bench pins the overhead
+(``resilience_guard_overhead_pct``).
+
+Policy, applied inside the program:
+
+- **skip-and-log** — a non-finite step applies no update (params/opt_state
+  pass through a ``lax.cond``, exactly the fp16 scaler's overflow-skip
+  mechanism, now available in every precision);
+- **escalating grad-clip** — for ``escalate_steps`` after a bad step the
+  global-norm clip tightens to ``escalate_clip`` (loss-spike weather often
+  precedes the NaN; clamping the recovery window is cheap insurance);
+- **last-known-good restore** — every clean check refreshes a rolling
+  on-device snapshot of (params, opt_state); ``restore_after`` consecutive
+  bad steps at a check boundary roll both back (poison that arrived
+  *finite* — a corrupted moment estimate, a diverged spike — is evicted
+  with them).
+
+Skipped-step and restore time feed the goodput ledger (categories
+``guard_skipped`` / ``guard_restore``), and every action emits a
+``{"kind": "resilience"}`` record through the telemetry hub.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class GuardPolicy:
+    """What the fused guard does about a non-finite step."""
+
+    skip_nonfinite: bool = True      # apply no update on a bad step
+    escalate_clip: Optional[float] = None  # tighter global-norm clip after a bad step
+    escalate_steps: int = 8          # how many steps the escalation persists
+    restore_after: int = 3           # K consecutive bad steps → restore last-known-good
+    snapshot_every: int = 1          # refresh the LKG snapshot every N clean checks (0 = never refresh)
+    check_every: Optional[int] = None  # host-check cadence (None = telemetry sample_every)
+
+
+def zero_guard_state() -> dict:
+    """The device-side guard state threaded through the jitted step."""
+    return {
+        "skipped": jnp.int32(0),      # total guard-skipped steps
+        "consecutive": jnp.int32(0),  # current run of bad steps
+        "escalate": jnp.int32(0),     # escalated-clip steps remaining
+    }
+
+
+def next_guard_state(gstate: dict, finite: jax.Array, escalate_steps: int) -> dict:
+    """Pure device-side state transition, traced into the step program."""
+    bad = ~finite
+    return {
+        "skipped": gstate["skipped"] + bad.astype(jnp.int32),
+        "consecutive": jnp.where(bad, gstate["consecutive"] + 1, 0),
+        "escalate": jnp.where(
+            bad, jnp.int32(escalate_steps), jnp.maximum(gstate["escalate"] - 1, 0)
+        ),
+    }
+
+
+def tree_all_finite(tree: Any) -> jax.Array:
+    """Device-side scalar: every floating leaf of ``tree`` is finite. For
+    manual loops that want the verdict without the fused policy."""
+    leaves = [
+        jnp.all(jnp.isfinite(x))
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def _copy_tree(tree: Any) -> Any:
+    # fresh device buffers: snapshots must survive the donation of the live
+    # params/opt_state buffers into the next step's program
+    return jax.tree.map(jnp.copy, tree)
+
+
+class NumericalGuard:
+    """Host-side companion of the fused device check: owns the device state,
+    the rolling last-known-good snapshot, and the fence-cadence policy
+    decisions. Constructed by the resilience hub; driven by
+    ``Accelerator.compiled_step``."""
+
+    def __init__(self, policy: Optional[GuardPolicy] = None, telemetry: Any = None):
+        self.policy = policy or GuardPolicy()
+        self.telemetry = telemetry
+        self.check_every = self.policy.check_every or 16
+        self.state: Optional[dict] = None  # device int32 scalars
+        self.steps = 0
+        self.skipped_steps = 0
+        self.restores = 0
+        self._seen_skipped = 0
+        self._clean_checks = 0
+        self._snapshot = None  # (params, opt_state) device copies
+        self._bound: Optional[tuple] = None  # (model, optimizer) of the guarded step
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def arm(self, model: Any, optimizer: Any) -> None:
+        """Initialize device state + the first snapshot (called lazily before
+        the first guarded step; params are already sharded by then)."""
+        self.state = zero_guard_state()
+        self._bound = (model, optimizer)
+        if self.policy.restore_after:
+            self._snapshot = (_copy_tree(model.params), _copy_tree(optimizer.opt_state))
+
+    # -- per-step (hot path: two integer ops off the check cadence) ---------
+
+    def after_step(self, model: Any, optimizer: Any) -> None:
+        self.steps += 1
+        if self.steps % self.check_every:
+            return
+        self.check(model, optimizer)
+
+    # -- the fence-cadence check -------------------------------------------
+
+    def check(self, model: Any, optimizer: Any) -> dict:
+        """Read the device state (the only host sync, on the fence cadence)
+        and act on it: log/ledger skipped steps, restore or refresh the
+        last-known-good snapshot."""
+        snap = {k: int(v) for k, v in jax.device_get(self.state).items()}
+        new_skipped = snap["skipped"] - self._seen_skipped
+        if new_skipped > 0:
+            self._seen_skipped = snap["skipped"]
+            self.skipped_steps += new_skipped
+            mean = None
+            if self.telemetry is not None:
+                mean = self.telemetry.timer.mean_step_seconds
+                # skipped steps burned a step's wall time without advancing
+                # training — that is lost time, and the ledger should say so
+                self.telemetry.goodput.record("guard_skipped", new_skipped * (mean or 0.0))
+            logger.warning(
+                f"numerical guard skipped {new_skipped} non-finite step(s) "
+                f"(total {snap['skipped']}, consecutive {snap['consecutive']})"
+            )
+            self._emit(
+                {
+                    "event": "guard_skip",
+                    "count": new_skipped,
+                    "skipped_total": snap["skipped"],
+                    "consecutive": snap["consecutive"],
+                }
+            )
+        if (
+            self.policy.restore_after
+            and snap["consecutive"] >= self.policy.restore_after
+            and self._snapshot is not None
+        ):
+            self._restore(model, optimizer, snap["consecutive"])
+        elif snap["consecutive"] == 0 and self._snapshot is not None:
+            self._clean_checks += 1
+            if self.policy.snapshot_every and self._clean_checks % self.policy.snapshot_every == 0:
+                # rolling refresh: async device-to-device copies, no host sync
+                self._snapshot = (_copy_tree(model.params), _copy_tree(optimizer.opt_state))
+        return snap
+
+    def _restore(self, model: Any, optimizer: Any, consecutive: int) -> None:
+        from contextlib import nullcontext
+
+        pause = (
+            self.telemetry.pause("guard_restore")
+            if self.telemetry is not None
+            else nullcontext()
+        )
+        with pause:
+            params, opt_state = self._snapshot
+            # copy again: the restored buffers get donated by the next step,
+            # and the snapshot must survive repeated restores
+            model.params = _copy_tree(params)
+            optimizer.opt_state = _copy_tree(opt_state)
+        # keep the skipped total, clear the bad streak + escalation
+        self.state = {
+            "skipped": jnp.int32(self._seen_skipped),
+            "consecutive": jnp.int32(0),
+            "escalate": jnp.int32(0),
+        }
+        self.restores += 1
+        logger.error(
+            f"numerical guard restored last-known-good params/opt_state after "
+            f"{consecutive} consecutive non-finite steps"
+        )
+        self._emit({"event": "guard_restore", "consecutive": consecutive})
+
+    def _emit(self, payload: dict) -> None:
+        if self.telemetry is not None:
+            self.telemetry.write_record("resilience", payload)
+
+    def summary(self) -> dict:
+        return {
+            "guard_steps": self.steps,
+            "guard_skipped_steps": self.skipped_steps,
+            "guard_restores": self.restores,
+        }

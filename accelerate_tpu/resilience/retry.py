@@ -1,0 +1,112 @@
+"""Unified retry policy: jittered exponential backoff over a pluggable
+transient-error classifier.
+
+PR 1 grew ``utils.memory.retry_transient_io`` for checkpoint saves; the same
+classify-and-retry shape is what the streamed big-model load path (memmap
+reads off GCS-fuse), the data loader (flaky dataset reads), and pod-launch
+relaunches need — so the loop lives here ONCE as :class:`RetryPolicy` and
+every consumer parameterizes it. ``retry_transient_io`` remains as a
+zero-jitter shim over this policy (its exact-backoff contract is pinned by
+tests), so nothing that already retried changes behavior.
+
+Jitter matters at fleet scale: a pod of hosts that all hit the same GCS 429
+and all retry after exactly 0.5 s re-synchronize into the next 429. The
+default ±25% jitter decorrelates them.
+
+Every retry (not the attempts themselves — the *backoffs*) is reported
+through :data:`retry_hook`, which the resilience hub points at the telemetry
+sink so ``telemetry.jsonl`` records ``{"kind": "resilience", "event":
+"retry", ...}`` whenever production weather was ridden out.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+# Module-level observer: called as hook(op, attempt, delay_s, exception) right
+# before each backoff sleep. Installed by resilience.hub.Resilience (weakly
+# bound to the telemetry sink); never allowed to break the retried operation.
+retry_hook: Optional[Callable[[str, int, float, Exception], None]] = None
+
+
+def _notify(op: str, attempt: int, delay: float, error: Exception) -> None:
+    hook = retry_hook
+    if hook is None:
+        return
+    try:
+        hook(op, attempt, delay, error)
+    except Exception:  # noqa: BLE001 - observers must never fail the retry
+        pass
+
+
+def _default_classify(exception: Exception) -> bool:
+    # lazy: utils.memory is the classifier's home (shared with the OOM
+    # classifier); importing it at module level would cycle through
+    # utils/__init__ → utils.offload → back here
+    from ..utils.memory import is_transient_io_error
+
+    return is_transient_io_error(exception)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How many times to retry, how long to wait, and what counts as retryable.
+
+    ``delay(attempt)`` for the attempt that just failed (0-based) is
+    ``min(base_delay * 2**attempt, max_delay)`` scaled by a uniform
+    ``1 ± jitter`` factor. ``classify=None`` uses
+    ``utils.memory.is_transient_io_error`` (flaky-filesystem weather);
+    ``sleep`` is injectable for tests and for callers that must resolve
+    ``time.sleep`` in their own namespace.
+    """
+
+    max_attempts: int = 4
+    base_delay: float = 0.5
+    max_delay: float = 8.0
+    jitter: float = 0.25
+    classify: Optional[Callable[[Exception], bool]] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def delay_for(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        delay = min(self.base_delay * (2**attempt), self.max_delay)
+        if self.jitter:
+            draw = (rng or random).random()
+            delay *= 1.0 + self.jitter * (2.0 * draw - 1.0)
+        return max(delay, 0.0)
+
+    def call(self, function: Callable, *args, **kwargs):
+        """Run ``function(*args, **kwargs)``, retrying classified-transient
+        failures with backoff. Non-transient errors and the final attempt's
+        failure propagate unchanged."""
+        classify = self.classify or _default_classify
+        op = getattr(function, "__name__", None) or "call"
+        for attempt in range(self.max_attempts):
+            try:
+                return function(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - classifier decides
+                if attempt == self.max_attempts - 1 or not classify(e):
+                    raise
+                delay = self.delay_for(attempt)
+                _notify(op, attempt + 1, delay, e)
+                self.sleep(delay)
+
+    def wrap(self, function: Optional[Callable] = None):
+        """Decorator form of :meth:`call` (usable bare or parameterized)."""
+        if function is None:
+            return self.wrap
+
+        @functools.wraps(function)
+        def wrapper(*args, **kwargs):
+            return self.call(function, *args, **kwargs)
+
+        return wrapper
+
+
+# The stack-wide default for filesystem/network I/O: what fault_tolerance's
+# commit protocol, the disk-offload weight store, and the data loader's batch
+# fetch all ride unless a caller passes its own policy.
+DEFAULT_IO_RETRY = RetryPolicy()

@@ -8,7 +8,7 @@ EOS. Later serving work (paging, multi-host serve meshes, speculative
 decoding) builds on these pieces.
 """
 
-from .engine import ServingEngine, ServingResult, params_from_streamed
+from .engine import ServingEngine, ServingResult, StepWatchdog, params_from_streamed
 from .kv_cache import SlotAllocator, SlotKVCache, bucket_for, kv_cache_bytes, prefill_buckets
 from .loadgen import make_prompts, run_offered_load
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
@@ -21,6 +21,7 @@ __all__ = [
     "ServingResult",
     "SlotAllocator",
     "SlotKVCache",
+    "StepWatchdog",
     "bucket_for",
     "kv_cache_bytes",
     "make_prompts",

@@ -23,10 +23,25 @@ multiplex through it via the slot cache —
 After warmup (one prefill+insert program per bucket + one decode program),
 steady state compiles NOTHING — the acceptance invariant
 ``tests/test_serving.py`` pins with ``CompileTracker``.
+
+Degradation under stress is graceful by design (resilience PR, see
+docs/resilience.md): per-request **deadlines** and client **cancellation**
+retire a doomed request at the top of the next ``step()`` (its slot serves
+the queue immediately); a saturated queue **sheds** with a ``retry_after``
+hint derived from the engine's measured service rate; a wall-clock
+**watchdog** thread reports a hung or oversized decode step that the
+blocked host thread cannot report itself; and a slot that produces
+non-finite logits is **quarantined** — its request requeues at the head of
+the line, and the slot re-enters circulation only after a finite-logits
+probe (it rides the fixed-shape decode step for free) passes. Every
+degradation event lands in ``ServingStats`` and, when a telemetry hub is
+attached, as a ``{"kind": "resilience"}`` record in ``telemetry.jsonl``.
 """
 
 from __future__ import annotations
 
+import math
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
@@ -50,9 +65,9 @@ class ServingResult:
     request_id: int
     prompt: np.ndarray  # [S]
     generated: np.ndarray  # [<= max_new_tokens], ends with EOS when hit
-    finish_reason: str  # "eos" | "length"
-    ttft_s: float
-    latency_s: float
+    finish_reason: str  # "eos" | "length" | "expired" | "cancelled" | "failed"
+    ttft_s: Optional[float]
+    latency_s: Optional[float]
 
     @property
     def tokens(self) -> np.ndarray:
@@ -85,6 +100,51 @@ def params_from_streamed(streamed) -> dict:
     return params
 
 
+class StepWatchdog:
+    """Wall-clock monitor for the blocking decode step.
+
+    A wedged XLA call (hung collective, runaway program) blocks the host
+    thread that would report it — so a single daemon thread watches a
+    deadline the engine arms around every decode. One trip per armed step;
+    idle (disarmed) the thread just sleeps its poll interval. ``close()``
+    stops the thread (the engine never needs to: daemon threads die with
+    the process, and an engine outlives its steps)."""
+
+    def __init__(self, timeout_s: float, on_hang, poll_s: Optional[float] = None):
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self.poll_s = poll_s if poll_s is not None else max(self.timeout_s / 4.0, 0.01)
+        self.fired = False
+        self._deadline: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self) -> None:
+        self.fired = False
+        self._deadline = time.monotonic() + self.timeout_s
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="accelerate-tpu-step-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def disarm(self) -> None:
+        self._deadline = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            deadline = self._deadline
+            if deadline is not None and not self.fired and time.monotonic() > deadline:
+                self.fired = True
+                try:
+                    self.on_hang(time.monotonic() - deadline + self.timeout_s)
+                except Exception:  # noqa: BLE001 - the monitor must keep monitoring
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+
+
 class ServingEngine:
     """Slot-multiplexed decode over any model with the decode protocol.
 
@@ -107,6 +167,10 @@ class ServingEngine:
         dtype=None,
         max_queue: Optional[int] = None,
         telemetry: Any = None,
+        step_timeout_s: Optional[float] = None,
+        fault_plan: Any = None,
+        max_probe_failures: int = 16,
+        max_request_requeues: int = 2,
     ):
         self.model = model
         self.params = params
@@ -134,6 +198,27 @@ class ServingEngine:
 
             self.compiles = CompileTracker().start()
         self._steps = 0
+        # -- degradation machinery (resilience PR) --------------------------
+        self.step_timeout_s = step_timeout_s
+        self._watchdog = (
+            StepWatchdog(step_timeout_s, self._on_watchdog_trip)
+            if step_timeout_s is not None
+            else None
+        )
+        # chaos harness: explicit plan wins; else whatever the resilience hub
+        # activated process-wide (ACCELERATE_CHAOS_* env path)
+        if fault_plan is None:
+            from ..resilience import chaos as _chaos_mod
+
+            fault_plan = _chaos_mod.active_plan()
+        self.chaos = fault_plan
+        self.max_probe_failures = max_probe_failures
+        # a request re-quarantined this many times is failing on its own
+        # merits (input-driven non-finite logits), not a bad slot's — fail it
+        # instead of requeue-livelocking the engine
+        self.max_request_requeues = max_request_requeues
+        self._probe_failures: dict[int, int] = {}
+        self._decode_warm = False  # first decode completed (compile behind us)
 
     # -- jitted programs (dot-keyed: shared cache with generate()) ----------
 
@@ -151,12 +236,15 @@ class ServingEngine:
                     # drives positions and the causal-over-cache mask inside
                     cache = {"k": k1[:, None], "v": v1[:, None], "length": length}
                     logits, nc = fwc(params, token[None, None], cache)
-                    return sample(logits, key)[0], nc["k"][:, 0], nc["v"][:, 0]
+                    # per-slot finite verdict: the quarantine trigger AND the
+                    # quarantined slot's probe, computed where the logits are
+                    ok = jnp.all(jnp.isfinite(logits))
+                    return sample(logits, key)[0], ok, nc["k"][:, 0], nc["v"][:, 0]
 
-                nxt, k2, v2 = jax.vmap(one_slot, in_axes=(0, 1, 1, 0, 0), out_axes=(0, 1, 1))(
-                    tokens, k, v, lengths, keys
-                )
-                return jnp.where(active, nxt, jnp.int32(0)), k2, v2
+                nxt, ok, k2, v2 = jax.vmap(
+                    one_slot, in_axes=(0, 1, 1, 0, 0), out_axes=(0, 0, 1, 1)
+                )(tokens, k, v, lengths, keys)
+                return jnp.where(active, nxt, jnp.int32(0)), ok, k2, v2
 
             donate = (1, 2) if self._donate else ()
             return jax.jit(decode_step, donate_argnums=donate)
@@ -176,6 +264,25 @@ class ServingEngine:
             return jax.jit(prefill)
 
         return self._jit(("serve_prefill", bucket), build)
+
+    def _scrub_program(self):
+        """Zero one slot's K/V. Quarantine needs it: non-finite values left in
+        a slot poison every later decode of that slot through the attention
+        matmul — a masked position's softmax weight is exactly 0.0, but
+        0 × NaN is still NaN, so masking alone cannot contain the damage.
+        Compiled lazily on the first quarantine (never in a healthy run)."""
+
+        def build():
+            def scrub(k, v, slot):
+                zeros = jnp.zeros((k.shape[0], 1) + k.shape[2:], k.dtype)
+                k = jax.lax.dynamic_update_slice(k, zeros, (0, slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(v, zeros.astype(v.dtype), (0, slot, 0, 0, 0))
+                return k, v
+
+            donate = (0, 1) if self._donate else ()
+            return jax.jit(scrub, donate_argnums=donate)
+
+        return self._jit(("serve_scrub", self.cache.num_slots, self.cache.max_len), build)
 
     def _insert_program(self, bucket: int):
         def build():
@@ -221,15 +328,20 @@ class ServingEngine:
         max_new_tokens: int = 32,
         request_id: Optional[int] = None,
         submitted_at: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> int:
         """Enqueue one request; returns its id. Raises ``ValueError`` for
         prompts the engine can never serve (too long for the cache) and
-        :class:`QueueFull` when admission control rejects.
+        :class:`QueueFull` when admission control sheds — carrying the queue
+        depth and a ``retry_after_s`` estimate from the engine's measured
+        service rate, so clients back off instead of hammering.
 
         ``submitted_at`` (a ``time.perf_counter`` stamp) backdates the
         request for latency accounting — load generators pass the intended
         arrival time so queue-full deferral shows up in TTFT instead of
-        vanishing from it."""
+        vanishing from it. ``deadline_s`` arms per-request expiry (relative
+        to submission): a request past its deadline is retired — queued or
+        mid-decode — at the top of the next ``step()``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -248,13 +360,44 @@ class ServingEngine:
             )
         try:
             request = self.scheduler.submit(
-                prompt, max_new_tokens, request_id=request_id, submitted_at=submitted_at
+                prompt,
+                max_new_tokens,
+                request_id=request_id,
+                submitted_at=submitted_at,
+                deadline_s=deadline_s,
             )
-        except QueueFull:
+        except QueueFull as e:
             self.stats.record_reject()
-            raise
+            hint = self.retry_after_hint()
+            self._resilience(
+                {"event": "shed", "queue_depth": e.queue_depth, "retry_after_s": hint}
+            )
+            raise QueueFull(
+                f"{e} — retry in ~{hint:.3f}s",
+                queue_depth=e.queue_depth,
+                retry_after_s=hint,
+            ) from None
         self.stats.record_submit()
         return request.id
+
+    def cancel(self, request_id: int) -> bool:
+        """Client cancellation. Queued or active, the request is retired (and
+        an active one's slot freed) at the top of the next ``step()``; returns
+        whether the id was found in flight."""
+        return self.scheduler.cancel(request_id)
+
+    def retry_after_hint(self) -> float:
+        """Estimated seconds until a queue position frees: the backlog drains
+        in waves of ``num_slots`` requests, each wave lasting roughly (mean
+        tokens per request) × (mean decode-step time). Before any history
+        exists, a conservative small constant."""
+        s = self.stats
+        mean_step = (s.decode_seconds / s.steps) if s.steps else 0.01
+        mean_tokens = (
+            s.tokens_generated / s.requests_completed if s.requests_completed else 16.0
+        )
+        waves = math.ceil((self.scheduler.waiting + 1) / self.cache.num_slots)
+        return round(max(waves * mean_tokens * mean_step, mean_step), 4)
 
     def _admit(self, slot: int, request: Request) -> None:
         prefill_len = request.prompt.size - 1
@@ -276,22 +419,117 @@ class ServingEngine:
 
     # -- the engine loop ---------------------------------------------------
 
+    def _result_for(self, request) -> ServingResult:
+        return ServingResult(
+            request_id=request.id,
+            prompt=request.prompt,
+            generated=np.asarray(request.generated, np.int32),
+            finish_reason=request.finish_reason,
+            ttft_s=request.ttft_s,
+            latency_s=request.latency_s,
+        )
+
+    def _retire_degraded(self, now: float) -> list[ServingResult]:
+        """Deadline expiry + client cancellation, queued AND active: a doomed
+        request never consumes another decode step, and its slot serves the
+        queue immediately ("freed by the next step" is the acceptance
+        invariant — this runs at the top of every step, before admission)."""
+        results = []
+        for request in self.scheduler.sweep_queue(now):
+            self._record_degraded(request)
+            results.append(self._result_for(request))
+        for slot in self.scheduler.active_slots:
+            request = self.scheduler.slots[slot]
+            reason = (
+                "cancelled"
+                if request.cancelled
+                else ("expired" if request.past_deadline(now) else None)
+            )
+            if reason is None:
+                continue
+            self.cache.retire(slot)
+            done = self.scheduler.retire(slot, reason)
+            self._record_degraded(done, slot=slot)
+            results.append(self._result_for(done))
+        return results
+
+    def _record_degraded(self, request, slot: Optional[int] = None) -> None:
+        if request.finish_reason == "cancelled":
+            self.stats.record_cancelled()
+        else:
+            self.stats.record_expired()
+        payload = {"event": request.finish_reason, "request_id": request.id}
+        if slot is not None:
+            payload["slot"] = slot
+        self._resilience(payload)
+
+    def _inject_chaos_burst(self) -> None:
+        """Queue-pressure burst from the chaos plan: synthetic requests pushed
+        straight into the scheduler queue (bypassing admission control — the
+        point is to saturate it so real submits shed)."""
+        burst = self.chaos.serving_burst(self._steps) if self.chaos is not None else 0
+        if not burst:
+            return
+        rng = np.random.default_rng(self.chaos.seed)
+        for _ in range(burst):
+            request = Request(
+                id=next(self.scheduler._ids),
+                prompt=rng.integers(0, 64, (2,)).astype(np.int32),
+                max_new_tokens=1,
+            )
+            self.scheduler.queue.append(request)  # straight past admission control
+            self.stats.record_submit()
+
+    def _on_watchdog_trip(self, elapsed_s: float) -> None:
+        self.stats.record_watchdog_trip()
+        self._resilience(
+            {
+                "event": "watchdog",
+                "step": self._steps,
+                "elapsed_s": round(elapsed_s, 4),
+                "timeout_s": self.step_timeout_s,
+            }
+        )
+
     def step(self) -> list[ServingResult]:
-        """One engine iteration: admit into free slots, run one decode step
-        over every active slot, retire finished requests. Returns the
-        requests that finished THIS step."""
+        """One engine iteration: retire expired/cancelled requests, admit into
+        free slots, run one decode step over every active slot (plus the
+        finite-logits probe of any quarantined slot, which rides the same
+        fixed-shape program), quarantine slots that produced non-finite
+        logits, retire finished requests. Returns the requests that finished
+        THIS step (including expired/cancelled ones, with their reason)."""
         t0 = time.perf_counter()
+        finished: list[ServingResult] = self._retire_degraded(t0)
+        self._inject_chaos_burst()
         for slot, request in self.scheduler.admit_ready(
             lambda req: self.cache.admit(req.prompt.size - 1)
         ):
             self._admit(slot, request)
 
         active_idx = self.scheduler.active_slots
-        if not active_idx:
-            return []
+        quarantined = sorted(self.cache.quarantined)
+        if not active_idx and not quarantined:
+            return finished
+        if not active_idx and quarantined and self.scheduler.waiting:
+            # fail loudly rather than spin run() forever: every slot is
+            # quarantined and none is coming back within the probe budget
+            if all(
+                self._probe_failures.get(s, 0) >= self.max_probe_failures for s in quarantined
+            ):
+                raise RuntimeError(
+                    f"all {len(quarantined)} slots quarantined and the finite-logits "
+                    f"probe failed {self.max_probe_failures}x on each — the model/params "
+                    "are producing non-finite logits unconditionally"
+                )
 
+        # the watchdog watches steady-state decode, not XLA compilation: the
+        # very first decode (and any step that compiled a new program) may
+        # legitimately take seconds, and a trip there is pure noise
+        compiles_before = self.compiles.compile_count
+        if self._watchdog is not None and self._decode_warm:
+            self._watchdog.arm()
         keys = jax.random.split(jax.random.fold_in(self._rng, self._steps), self.cache.num_slots)
-        nxt, self.cache.k, self.cache.v = self._decode_program()(
+        nxt, ok, self.cache.k, self.cache.v = self._decode_program()(
             self.params,
             self.cache.k,
             self.cache.v,
@@ -301,12 +539,56 @@ class ServingEngine:
             keys,
         )
         tokens = np.asarray(nxt)  # host fetch = the per-step fence + EOS gate
+        finite = np.asarray(ok)
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         self._steps += 1
         now = time.perf_counter()
+        compiled_this_step = self.compiles.compile_count > compiles_before
+        if (
+            self.step_timeout_s is not None
+            and self._decode_warm
+            and not compiled_this_step
+            and now - t0 > self.step_timeout_s
+            and not (self._watchdog is not None and self._watchdog.fired)
+        ):
+            # oversized-but-completed step the poll-based thread missed
+            self._on_watchdog_trip(now - t0)
+        self._decode_warm = True
 
-        finished: list[ServingResult] = []
+        delivered = 0
         for slot in active_idx:
             request = self.scheduler.slots[slot]
+            if not finite[slot]:
+                # poisoned slot: quarantine + scrub it (0 × NaN = NaN, so
+                # masked poison would otherwise fail every probe forever).
+                # The request requeues at the head of the line — unless it
+                # has already been requeued max_request_requeues times, in
+                # which case the *request* is what drives the model
+                # non-finite and it fails instead of livelocking everyone.
+                if request.requeues >= self.max_request_requeues:
+                    done = self.scheduler.retire(slot, "failed")
+                    self.stats.record_failed()
+                    self._resilience(
+                        {"event": "failed", "slot": slot, "request_id": done.id,
+                         "requeues": done.requeues}
+                    )
+                    finished.append(self._result_for(done))
+                else:
+                    self.scheduler.requeue_front(slot)
+                    self.stats.record_requeue()
+                    self._resilience(
+                        {"event": "quarantine", "slot": slot, "request_id": request.id}
+                    )
+                self.cache.quarantine(slot)
+                self.cache.k, self.cache.v = self._scrub_program()(
+                    self.cache.k, self.cache.v, np.int32(slot)
+                )
+                self._pending[slot] = 0
+                self._probe_failures[slot] = 0
+                self.stats.record_quarantine()
+                continue
+            delivered += 1
             token = int(tokens[slot])
             request.generated.append(token)
             self.cache.lengths[slot] += 1
@@ -318,19 +600,24 @@ class ServingEngine:
                 self.cache.retire(slot)
                 done = self.scheduler.retire(slot, "eos" if hit_eos else "length")
                 self.stats.record_finish(done.latency_s)
-                finished.append(
-                    ServingResult(
-                        request_id=done.id,
-                        prompt=done.prompt,
-                        generated=np.asarray(done.generated, np.int32),
-                        finish_reason=done.finish_reason,
-                        ttft_s=done.ttft_s,
-                        latency_s=done.latency_s,
-                    )
-                )
+                finished.append(self._result_for(done))
             else:
                 self._pending[slot] = token
-        self.stats.record_step(now - t0, active=len(active_idx), waiting=self.scheduler.waiting)
+
+        for slot in quarantined:
+            # the probe IS this step's decode of the (empty) quarantined slot
+            if finite[slot]:
+                self.cache.release_quarantined(slot)
+                self._probe_failures.pop(slot, None)
+                self.stats.record_quarantine_release()
+                self._resilience({"event": "quarantine_release", "slot": slot})
+            else:
+                self._probe_failures[slot] = self._probe_failures.get(slot, 0) + 1
+
+        self.stats.record_step(
+            now - t0, active=len(active_idx), waiting=self.scheduler.waiting,
+            tokens=delivered,
+        )
         return finished
 
     @property
@@ -384,6 +671,12 @@ class ServingEngine:
         if self.telemetry is None:
             return None
         return self.telemetry.write_record("serving", {"serving": self.metrics()})
+
+    def _resilience(self, payload: dict) -> None:
+        """One ``{"kind": "resilience"}`` degradation record (shed, expiry,
+        cancellation, quarantine, watchdog) — no-op without a hub."""
+        if self.telemetry is not None:
+            self.telemetry.write_record("resilience", payload)
 
     # -- alternate loaders -------------------------------------------------
 

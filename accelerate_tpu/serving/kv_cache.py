@@ -65,7 +65,12 @@ def kv_cache_bytes(
 
 class SlotAllocator:
     """Free-slot stack: O(1) admit/retire, slots reused LIFO (a freshly
-    retired slot's cache lines are the hottest)."""
+    retired slot's cache lines are the hottest).
+
+    A slot that produced non-finite logits can be **quarantined**: it leaves
+    the in-use set but does NOT return to the free stack, so no request can
+    land on it until a finite-logits probe passes and ``release`` returns it
+    to circulation (serving degradation, resilience PR)."""
 
     def __init__(self, num_slots: int):
         if num_slots < 1:
@@ -73,6 +78,7 @@ class SlotAllocator:
         self.num_slots = num_slots
         self._free = list(range(num_slots - 1, -1, -1))  # pop() yields slot 0 first
         self._in_use: set[int] = set()
+        self._quarantined: set[int] = set()
 
     def admit(self) -> Optional[int]:
         """Claim a free slot, or None when every slot is occupied."""
@@ -89,6 +95,20 @@ class SlotAllocator:
         self._in_use.discard(slot)
         self._free.append(slot)
 
+    def quarantine(self, slot: int) -> None:
+        """Pull an in-use slot out of circulation (no free-stack return)."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not in use")
+        self._in_use.discard(slot)
+        self._quarantined.add(slot)
+
+    def release(self, slot: int) -> None:
+        """A quarantined slot passed its probe: back to the free stack."""
+        if slot not in self._quarantined:
+            raise ValueError(f"slot {slot} is not quarantined")
+        self._quarantined.discard(slot)
+        self._free.append(slot)
+
     @property
     def free_count(self) -> int:
         return len(self._free)
@@ -96,6 +116,10 @@ class SlotAllocator:
     @property
     def used_count(self) -> int:
         return len(self._in_use)
+
+    @property
+    def quarantined(self) -> frozenset:
+        return frozenset(self._quarantined)
 
     @property
     def occupancy(self) -> float:
@@ -153,3 +177,22 @@ class SlotKVCache:
         self.allocator.retire(slot)
         self.lengths[slot] = 0
         self.active[slot] = False
+
+    def quarantine(self, slot: int) -> None:
+        """Take a poisoned slot out of circulation. ``length`` resets to 0 so
+        the probe decode (token 0 over an empty cache — its own K/V write is
+        the only visible position) exercises the slot without reading the
+        suspect prefix."""
+        self.allocator.quarantine(slot)
+        self.lengths[slot] = 0
+        self.active[slot] = False
+
+    def release_quarantined(self, slot: int) -> None:
+        """Probe passed: the slot may serve requests again."""
+        self.allocator.release(slot)
+        self.lengths[slot] = 0
+        self.active[slot] = False
+
+    @property
+    def quarantined(self) -> frozenset:
+        return self.allocator.quarantined

@@ -10,6 +10,14 @@ Lifecycle: ``submit`` (admission control on queue depth) → FIFO queue →
 engine reports each slot's new token → ``retire`` frees the slot, which the
 very next ``admit_ready`` can hand to a queued request — finished requests
 never hold capacity for even one extra step.
+
+Degradation (resilience PR): requests may carry a ``deadline_s`` and may be
+``cancel()``-ed by the client; the engine retires expired/cancelled requests
+at the top of every step, so a doomed request never holds a slot past the
+next ``step()``. A rejected ``submit`` raises :class:`QueueFull` carrying
+the queue depth and a ``retry_after_s`` hint so clients can shed load
+intelligently instead of hammering. ``requeue_front`` puts a request whose
+slot went bad back at the head of the line.
 """
 
 from __future__ import annotations
@@ -24,7 +32,23 @@ import numpy as np
 
 
 class QueueFull(RuntimeError):
-    """Admission control: the request queue is at ``max_queue`` depth."""
+    """Admission control: the request queue is at ``max_queue`` depth.
+
+    ``queue_depth`` is the number of waiting requests at rejection time;
+    ``retry_after_s`` (set by the engine, which knows its service rate) is
+    the estimated seconds until a queue position frees — the load-shedding
+    hint a client should back off by.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        queue_depth: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -35,14 +59,27 @@ class Request:
     prompt: np.ndarray  # [S] int32
     max_new_tokens: int
     submitted_at: float = field(default_factory=time.perf_counter)
+    deadline_s: Optional[float] = None  # relative to submitted_at; None = no deadline
     # filled in as the request moves through the engine
     slot: Optional[int] = None
     prefill_bucket: Optional[int] = None
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
-    finish_reason: Optional[str] = None  # "eos" | "length"
+    finish_reason: Optional[str] = None  # "eos" | "length" | "expired" | "cancelled"
     generated: list[int] = field(default_factory=list)
+    cancelled: bool = False
+    requeues: int = 0  # times a bad slot sent this request back to the queue
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
+    def past_deadline(self, now: float) -> bool:
+        deadline = self.deadline_at
+        return deadline is not None and now >= deadline
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -75,22 +112,56 @@ class ContinuousBatchingScheduler:
         max_new_tokens: int,
         request_id: Optional[int] = None,
         submitted_at: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """Enqueue a request. Raises :class:`QueueFull` past ``max_queue``
         waiting requests — backpressure belongs at admission, not OOM.
-        ``submitted_at`` backdates the latency clock (deferred arrivals)."""
+        ``submitted_at`` backdates the latency clock (deferred arrivals);
+        ``deadline_s`` arms per-request expiry relative to submission."""
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             raise QueueFull(
-                f"request queue is full ({len(self.queue)}/{self.max_queue} waiting)"
+                f"request queue is full ({len(self.queue)}/{self.max_queue} waiting)",
+                queue_depth=len(self.queue),
             )
         request = Request(
             id=next(self._ids) if request_id is None else request_id,
             prompt=prompt,
             max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s,
         )
         if submitted_at is not None:
             request.submitted_at = submitted_at
         self.queue.append(request)
+        return request
+
+    def cancel(self, request_id: int) -> bool:
+        """Client cancellation: mark the request wherever it lives. A queued
+        request is dropped by the engine's next degradation sweep; an active
+        one is retired (slot freed) at the top of the next ``step()``."""
+        for request in self.queue:
+            if request.id == request_id:
+                request.cancelled = True
+                return True
+        for request in self.slots:
+            if request is not None and request.id == request_id:
+                request.cancelled = True
+                return True
+        return False
+
+    def requeue_front(self, slot: int) -> Request:
+        """Pull the request out of a bad slot and put it back at the HEAD of
+        the queue (it already waited its turn) for a fresh admission — used
+        when the slot is quarantined. Generated tokens are discarded: the
+        slot's cache is suspect, so the request restarts from its prompt."""
+        request = self.slots[slot]
+        if request is None:
+            raise ValueError(f"slot {slot} holds no request")
+        self.slots[slot] = None
+        request.slot = None
+        request.generated = []
+        request.first_token_at = None  # TTFT restarts honestly: no trusted token yet
+        request.requeues += 1
+        self.queue.appendleft(request)
         return request
 
     # -- slot lifecycle ----------------------------------------------------
@@ -109,6 +180,26 @@ class ContinuousBatchingScheduler:
             request.admitted_at = time.perf_counter()
             self.slots[slot] = request
             yield slot, request
+
+    def sweep_queue(self, now: float) -> list[Request]:
+        """Remove cancelled / past-deadline requests from the waiting queue
+        (they must never consume a prefill or a slot). Returns the removed
+        requests with ``finish_reason`` set."""
+        kept: deque[Request] = deque()
+        dropped: list[Request] = []
+        for request in self.queue:
+            if request.cancelled:
+                reason = "cancelled"
+            elif request.past_deadline(now):
+                reason = "expired"
+            else:
+                kept.append(request)
+                continue
+            request.finished_at = now
+            request.finish_reason = reason
+            dropped.append(request)
+        self.queue = kept
+        return dropped
 
     def retire(self, slot: int, reason: str) -> Request:
         request = self.slots[slot]

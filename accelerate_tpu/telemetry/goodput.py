@@ -11,6 +11,10 @@ categories match where production runs actually bleed time:
 - ``compile``              — XLA compilation (fed from CompileTracker)
 - ``startup``              — process start → first training step (imports,
                              mesh bootstrap, rendezvous)
+- ``guard_skipped``        — steps the numerical guard skipped (wall time
+                             burned without advancing training; resilience)
+- ``guard_restore``        — last-known-good restore after consecutive
+                             non-finite steps (resilience/guards.py)
 
 Productive time comes from the StepTimer (measured window time extrapolated
 over all steps), so the ratio needs no extra synchronization. The ledger is
@@ -22,7 +26,15 @@ from __future__ import annotations
 import time
 from contextlib import contextmanager
 
-CATEGORIES = ("checkpoint_save", "checkpoint_restore", "dataloader_rewind", "compile", "startup")
+CATEGORIES = (
+    "checkpoint_save",
+    "checkpoint_restore",
+    "dataloader_rewind",
+    "compile",
+    "startup",
+    "guard_skipped",
+    "guard_restore",
+)
 
 
 class GoodputTracker:

@@ -25,6 +25,7 @@ out to any active ``tracking.py`` trackers.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
@@ -74,6 +75,9 @@ class Telemetry:
         self._first_step_done = False
         self.optimizer_steps = 0
         self._file = None
+        # serving's step watchdog reports hangs from a side thread; the jsonl
+        # sink must not interleave lines or double-open under that race
+        self._write_lock = threading.Lock()
         self._finished = False
         self._last_flush_step: Optional[int] = None
         self._throughput: dict[str, float] = {}
@@ -288,10 +292,12 @@ class Telemetry:
     def _write(self, record: dict) -> None:
         from ..tracking import dumps_robust
 
-        if self._file is None:
-            self._file = open(self._sink_path(), "a")
-        self._file.write(dumps_robust(record) + "\n")
-        self._file.flush()
+        line = dumps_robust(record) + "\n"
+        with self._write_lock:
+            if self._file is None:
+                self._file = open(self._sink_path(), "a")
+            self._file.write(line)
+            self._file.flush()
 
     def finish(self, flush: bool = True) -> None:
         """Final flush + release hooks. Collective when multi-host (the final
@@ -306,14 +312,15 @@ class Telemetry:
         if flush and self.timer.steps:
             self.flush(step=self.timer.steps)
         self.compiles.stop()
-        if self._file is not None:
-            try:
-                self._file.flush()
-                os.fsync(self._file.fileno())
-            except (OSError, ValueError):
-                pass
-            self._file.close()
-            self._file = None
+        with self._write_lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except (OSError, ValueError):
+                    pass
+                self._file.close()
+                self._file = None
 
     def to_json(self) -> str:
         from ..tracking import dumps_robust

@@ -48,6 +48,15 @@ class ServingStats:
         self.requests_completed = 0
         self.requests_rejected = 0
         self.max_active = 0
+        # degradation counters (resilience PR): every graceful-failure path
+        # is countable, or ops cannot tell "degrading as designed" from "broken"
+        self.requests_expired = 0
+        self.requests_cancelled = 0
+        self.requests_requeued = 0
+        self.requests_failed = 0
+        self.slot_quarantines = 0
+        self.slot_quarantine_releases = 0
+        self.watchdog_trips = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -57,16 +66,42 @@ class ServingStats:
     def record_reject(self) -> None:
         self.requests_rejected += 1
 
+    def record_expired(self) -> None:
+        self.requests_expired += 1
+
+    def record_cancelled(self) -> None:
+        self.requests_cancelled += 1
+
+    def record_requeue(self) -> None:
+        self.requests_requeued += 1
+
+    def record_failed(self) -> None:
+        self.requests_failed += 1
+
+    def record_quarantine(self) -> None:
+        self.slot_quarantines += 1
+
+    def record_quarantine_release(self) -> None:
+        self.slot_quarantine_releases += 1
+
+    def record_watchdog_trip(self) -> None:
+        self.watchdog_trips += 1
+
     def record_prefill(self, bucket: int) -> None:
         self.prefill_tokens += bucket
 
-    def record_step(self, duration_s: float, active: int, waiting: int) -> None:
+    def record_step(
+        self, duration_s: float, active: int, waiting: int, tokens: Optional[int] = None
+    ) -> None:
+        """``tokens`` = tokens actually delivered this step (defaults to
+        ``active``; the engine passes fewer when a quarantined slot's token
+        was discarded — throughput must never count undelivered tokens)."""
         if self.first_decode_at is None:
             self.first_decode_at = time.perf_counter() - duration_s
         self.steps += 1
         self.decode_seconds += duration_s
         self.step_seconds.append(duration_s)
-        self.tokens_generated += active
+        self.tokens_generated += active if tokens is None else tokens
         self.occupancy_sum += active / self.num_slots
         self.queue_depth_sum += waiting
         self.max_active = max(self.max_active, active)
@@ -105,6 +140,13 @@ class ServingStats:
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "requests_rejected": self.requests_rejected,
+            "requests_expired": self.requests_expired,
+            "requests_cancelled": self.requests_cancelled,
+            "requests_requeued": self.requests_requeued,
+            "requests_failed": self.requests_failed,
+            "slot_quarantines": self.slot_quarantines,
+            "slot_quarantine_releases": self.slot_quarantine_releases,
+            "watchdog_trips": self.watchdog_trips,
             "throughput_tokens_per_sec": round(self.throughput_tokens_per_sec, 3),
             "slot_occupancy": round(self.mean_occupancy, 4),
             "max_active_slots": self.max_active,

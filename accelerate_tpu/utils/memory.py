@@ -101,26 +101,31 @@ def retry_transient_io(
     max_delay: float = 8.0,
 ):
     """Decorator retrying ``function`` on transient I/O errors with exponential
-    backoff (mirrors ``find_executable_batch_size``'s classify-and-retry loop,
-    with sleep-and-double in place of halve-the-batch). Non-transient errors
-    and the final attempt's failure propagate unchanged.
+    backoff. A zero-jitter shim over ``resilience.retry.RetryPolicy`` (the
+    generalized, jittered policy the rest of the stack consumes) — kept so
+    existing call sites and the pinned exact-backoff contract stay unchanged.
+    Non-transient errors and the final attempt's failure propagate unchanged.
     """
     if function is None:
         return functools.partial(
             retry_transient_io, max_attempts=max_attempts, base_delay=base_delay, max_delay=max_delay
         )
 
+    from ..resilience.retry import RetryPolicy
+
+    policy = RetryPolicy(
+        max_attempts=max_attempts,
+        base_delay=base_delay,
+        max_delay=max_delay,
+        jitter=0.0,
+        # late-bound through THIS module so tests patching
+        # accelerate_tpu.utils.memory.time.sleep keep working
+        sleep=lambda seconds: time.sleep(seconds),
+    )
+
     @functools.wraps(function)
     def wrapper(*args, **kwargs):
-        delay = base_delay
-        for attempt in range(max_attempts):
-            try:
-                return function(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001 - classifier decides
-                if attempt == max_attempts - 1 or not is_transient_io_error(e):
-                    raise
-                time.sleep(min(delay, max_delay))
-                delay *= 2
+        return policy.call(function, *args, **kwargs)
 
     return wrapper
 

@@ -18,6 +18,8 @@ import numpy as np
 
 import ml_dtypes
 
+from ..resilience.retry import DEFAULT_IO_RETRY
+
 _DTYPES = {
     "bfloat16": ml_dtypes.bfloat16,
     "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
@@ -29,8 +31,13 @@ def _np_dtype(name: str):
     return _DTYPES.get(name, np.dtype(name))
 
 
+@DEFAULT_IO_RETRY.wrap
 def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
-    """Write one tensor as a raw memmap file; record it in ``index``."""
+    """Write one tensor as a raw memmap file; record it in ``index``.
+
+    Retried under the stack-wide I/O policy: offload dirs live on the same
+    flaky network filesystems checkpoints do, and a 4 GiB weight write is a
+    big EIO target."""
     weight = np.asarray(weight)
     dtype_name = weight.dtype.name
     array_path = os.path.join(offload_folder, f"{weight_name}.dat")
@@ -44,7 +51,10 @@ def offload_weight(weight, weight_name: str, offload_folder: str, index: Optiona
     return index if index is not None else {}
 
 
+@DEFAULT_IO_RETRY.wrap
 def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    """Open one offloaded memmap — the streamed big-model load path's disk
+    read, retried on transient-I/O weather like every other read."""
     shape = tuple(weight_info["shape"])
     if len(shape) == 0:
         shape = (1,)

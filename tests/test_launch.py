@@ -135,6 +135,40 @@ def test_verify_checkpoint_cli_no_checksums(tmp_path, capsys):
     assert cli_main(["verify-checkpoint", str(ckpt)]) == 1
 
 
+def test_verify_checkpoint_cli_repair(tmp_path, capsys):
+    """Satellite (resilience PR): --repair GCs torn .tmp staging dirs and
+    prunes checkpoints that fail checksum, printing what was removed; valid
+    checkpoints survive and still verify."""
+    from accelerate_tpu.fault_tolerance import build_manifest, write_manifest
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    good = tmp_path / "checkpoint_2"
+    good.mkdir()
+    (good / "w.bin").write_bytes(b"g" * 64)
+    write_manifest(str(good), build_manifest(str(good), step=2))
+    bad = tmp_path / "checkpoint_1"
+    bad.mkdir()
+    (bad / "w.bin").write_bytes(b"a" * 64)
+    write_manifest(str(bad), build_manifest(str(bad), step=1))
+    (bad / "w.bin").write_bytes(b"b" * 64)  # same-size bit rot after commit
+    torn = tmp_path / "checkpoint_3.tmp"
+    torn.mkdir()
+    (torn / "junk.bin").write_bytes(b"x" * 16)
+
+    assert cli_main(["verify-checkpoint", "--repair", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "REMOVED torn staging dir" in out and "checkpoint_3.tmp" in out
+    assert "PRUNED invalid checkpoint" in out and str(bad) in out
+    assert "OK" in out and str(good) in out
+    assert good.exists() and not bad.exists() and not torn.exists()
+    # idempotent: a second repair finds nothing to remove
+    assert cli_main(["verify-checkpoint", "--repair", str(tmp_path)]) == 0
+    assert "PRUNED" not in capsys.readouterr().out
+    # base-dir verify without --repair keeps reporting
+    assert cli_main(["verify-checkpoint", str(tmp_path)]) == 0
+
+
 def test_notebook_launcher_runs_inline():
     from accelerate_tpu import notebook_launcher
 

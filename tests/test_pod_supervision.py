@@ -8,6 +8,11 @@ import time
 import pytest
 
 from accelerate_tpu.commands.pod import supervise
+from accelerate_tpu.resilience import RetryPolicy
+
+# zero-delay relaunch policy: tests of the restart LOGIC shouldn't wait out
+# the production backoff (which has its own test below)
+_NO_BACKOFF = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0)
 
 
 def _spawn_script(scripts):
@@ -60,13 +65,13 @@ def test_restart_on_failure_retries_then_succeeds(tmp_path):
         f"sys.exit(7)\n"
     )
     spawn = _spawn_script([script])
-    assert supervise(spawn, 1, restarts=2, poll_interval=0.05) == 0
+    assert supervise(spawn, 1, restarts=2, poll_interval=0.05, restart_policy=_NO_BACKOFF) == 0
     assert marker.exists()
 
 
 def test_restarts_exhausted_returns_failure():
     spawn = _spawn_script(["import sys; sys.exit(9)"])
-    assert supervise(spawn, 1, restarts=1, poll_interval=0.05) == 9
+    assert supervise(spawn, 1, restarts=1, poll_interval=0.05, restart_policy=_NO_BACKOFF) == 9
 
 
 def test_worker_output_is_prefixed(capfd):
@@ -173,10 +178,94 @@ def test_supervise_passes_attempt_to_two_arg_spawn():
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
 
-    assert supervise(spawn, 1, restarts=1, poll_interval=0.05) == 0
+    assert supervise(spawn, 1, restarts=1, poll_interval=0.05, restart_policy=_NO_BACKOFF) == 0
     assert attempts == [(0, 1), (0, 2)]
 
 
 def test_supervise_single_arg_spawn_still_works():
     spawn = _spawn_script(["print('legacy')"])
     assert supervise(spawn, 1, poll_interval=0.05) == 0
+
+
+# -- resilience-PR satellites: fake-worker heartbeat kill + relaunch backoff --
+
+
+class _FakeProc:
+    """Popen-shaped stub: no subprocess, no gcloud — just a scripted exit."""
+
+    stdout = None
+
+    def __init__(self, returncode=None):
+        self._rc = returncode
+        self.killed = False
+
+    def poll(self):
+        return self._rc
+
+    def kill(self):
+        self.killed = True
+        self._rc = -9
+
+
+def test_heartbeat_timeout_kill_path_fake_workers():
+    """The heartbeat-timeout kill path with FAKE workers: a worker that never
+    produces output must be declared dead (exit 124) and every peer must be
+    killed — no real processes involved, so the path is tested in isolation
+    from subprocess/pipe timing."""
+    procs = [_FakeProc(), _FakeProc()]  # both alive, both silent forever
+    start = time.monotonic()
+    rc = supervise(
+        lambda i: procs[i], 2, heartbeat_timeout=0.2, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF,
+    )
+    assert rc == 124
+    assert all(p.killed for p in procs)
+    assert time.monotonic() - start < 10
+
+
+def test_heartbeat_ignores_chatty_workers():
+    """Workers whose last_activity keeps advancing are never heartbeat-killed:
+    the fleet runs to completion (fakes exit 0 after a few polls)."""
+    class Chatty(_FakeProc):
+        def __init__(self):
+            super().__init__()
+            self.polls = 0
+
+        def poll(self):
+            self.polls += 1
+            return 0 if self.polls > 3 else None
+
+    workers = []
+
+    def spawn(i):
+        proc = Chatty()
+        workers.append(proc)
+        return proc
+
+    rc = supervise(spawn, 2, heartbeat_timeout=5.0, poll_interval=0.01)
+    assert rc == 0
+    assert not any(w.killed for w in workers)
+
+
+def test_relaunch_backoff_follows_retry_policy():
+    """Satellite: the relaunch delay is the RetryPolicy's jittered-exponential
+    backoff, not an immediate restart — attempt N sleeps delay_for(N-1)."""
+    sleeps = []
+    policy = RetryPolicy(base_delay=0.5, max_delay=4.0, jitter=0.0, sleep=sleeps.append)
+    rc = supervise(
+        lambda i: _FakeProc(returncode=3), 1, restarts=2, poll_interval=0.01,
+        restart_policy=policy,
+    )
+    assert rc == 3
+    assert sleeps == [0.5, 1.0]  # exponential, zero-jitter for determinism
+
+
+def test_default_restart_policy_is_jittered_backoff():
+    from accelerate_tpu.commands.pod import RESTART_POLICY
+
+    assert RESTART_POLICY.base_delay > 0
+    assert RESTART_POLICY.jitter > 0
+    # delay_for stays within the jitter envelope and under the cap
+    for attempt in range(8):
+        d = RESTART_POLICY.delay_for(attempt)
+        assert 0 < d <= RESTART_POLICY.max_delay * (1 + RESTART_POLICY.jitter)

@@ -1,0 +1,461 @@
+"""Resilience subsystem (ISSUE 4 tentpole): chaos fault injection, fused
+numerical guards (skip / escalate / restore), the unified RetryPolicy, and
+the chaos end-to-end acceptance run — all on the CPU mesh."""
+
+import errno
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, CheckpointManager
+from accelerate_tpu.fault_tolerance import verify_checkpoint
+from accelerate_tpu.resilience import (
+    FaultPlan,
+    GuardPolicy,
+    ResilienceConfig,
+    RetryPolicy,
+    tree_all_finite,
+)
+from accelerate_tpu.resilience import retry as retry_mod
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.telemetry import TelemetryConfig
+
+
+class Tiny:
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (8, 4), jnp.float32)}
+
+    @staticmethod
+    def apply(params, x):
+        return x @ params["w"]
+
+
+def _loss(params, batch):
+    return jnp.mean(Tiny.apply(params, batch) ** 2)
+
+
+BATCH = jnp.ones((8, 8), jnp.float32)
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _guarded_accelerator(plan=None, policy=None, telemetry_dir=None, **acc_kwargs):
+    config = ResilienceConfig(
+        guard=policy if policy is not None else GuardPolicy(check_every=2),
+        fault_plan=plan,
+    )
+    telemetry = (
+        TelemetryConfig(dir=telemetry_dir, sample_every=2) if telemetry_dir else None
+    )
+    acc = Accelerator(resilience_config=config, telemetry_config=telemetry, **acc_kwargs)
+    model = acc.prepare_model(Tiny(), params=Tiny().init(jax.random.key(0)))
+    opt = acc.prepare_optimizer(optax.sgd(1e-2))
+    return acc, model, opt
+
+
+def _clean_params(n_steps: int) -> np.ndarray:
+    """Final weights of a fault-free run of ``n_steps`` (same init/batch)."""
+    acc = Accelerator()
+    model = acc.prepare_model(Tiny(), params=Tiny().init(jax.random.key(0)))
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    step = acc.compiled_step(_loss)
+    for _ in range(n_steps):
+        step(BATCH)
+    return np.asarray(jax.device_get(model.params["w"]))
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_jittered_backoff_and_hook():
+    calls = {"n": 0}
+    sleeps = []
+    notes = []
+    policy = RetryPolicy(max_attempts=3, base_delay=1.0, max_delay=8.0, jitter=0.5,
+                         sleep=sleeps.append)
+    previous = retry_mod.retry_hook
+    retry_mod.retry_hook = lambda *args: notes.append(args)
+    try:
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError(errno.EIO, "Input/output error")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+    finally:
+        retry_mod.retry_hook = previous
+    assert calls["n"] == 3
+    # jitter bounds: delay_for(i) = base·2^i scaled by 1 ± jitter
+    assert len(sleeps) == 2
+    assert 0.5 <= sleeps[0] <= 1.5
+    assert 1.0 <= sleeps[1] <= 3.0
+    # every backoff was reported (op, attempt, delay, error)
+    assert [n[0] for n in notes] == ["flaky", "flaky"]
+    assert [n[1] for n in notes] == [1, 2]
+
+
+def test_retry_policy_custom_classifier_gates_retries():
+    calls = {"n": 0}
+    policy = RetryPolicy(
+        max_attempts=3, classify=lambda e: isinstance(e, KeyError), sleep=lambda s: None
+    )
+
+    def always_keyerror():
+        calls["n"] += 1
+        raise KeyError("transient-ish")
+
+    with pytest.raises(KeyError):
+        policy.call(always_keyerror)
+    assert calls["n"] == 3  # classified retryable: all attempts burned
+
+    calls["n"] = 0
+
+    def valueerror():
+        calls["n"] += 1
+        raise ValueError("real bug")
+
+    with pytest.raises(ValueError):
+        policy.call(valueerror)
+    assert calls["n"] == 1  # not retryable: propagates immediately
+
+
+def test_retry_policy_delay_caps_at_max_delay():
+    policy = RetryPolicy(base_delay=1.0, max_delay=4.0, jitter=0.0)
+    assert [policy.delay_for(i) for i in range(5)] == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+def test_retry_hook_failure_never_breaks_the_retry():
+    def bad_hook(*args):
+        raise RuntimeError("observer bug")
+
+    previous = retry_mod.retry_hook
+    retry_mod.retry_hook = bad_hook
+    calls = {"n": 0}
+    try:
+        policy = RetryPolicy(max_attempts=2, sleep=lambda s: None)
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise OSError(errno.EIO, "Input/output error")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+    finally:
+        retry_mod.retry_hook = previous
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_from_env(monkeypatch):
+    assert FaultPlan.from_env() is None  # no chaos vars → no plan
+    monkeypatch.setenv("ACCELERATE_CHAOS_NAN_STEPS", "3, 7")
+    monkeypatch.setenv("ACCELERATE_CHAOS_NAN_TARGET", "loss")
+    monkeypatch.setenv("ACCELERATE_CHAOS_IO_FAILURES", "2")
+    monkeypatch.setenv("ACCELERATE_CHAOS_SIGTERM_STEP", "9")
+    monkeypatch.setenv("ACCELERATE_CHAOS_STALL_STEPS", "4")
+    monkeypatch.setenv("ACCELERATE_CHAOS_SERVING_BURST_STEP", "2")
+    monkeypatch.setenv("ACCELERATE_CHAOS_SERVING_BURST_SIZE", "5")
+    plan = FaultPlan.from_env()
+    assert plan.nan_steps == (3, 7)
+    assert plan.nan_target == "loss"
+    assert plan.io_failures == 2
+    assert plan.sigterm_step == 9
+    assert plan.stall_steps == (4,)
+    assert plan.serving_burst_step == 2 and plan.serving_burst_size == 5
+    assert plan.active
+    # chaos env arms the whole subsystem
+    assert ResilienceConfig.from_env().enabled
+
+
+def test_fault_plan_io_budget_is_finite():
+    plan = FaultPlan(io_failures=2)
+    with pytest.raises(OSError):
+        plan.probe_io("checkpoint_save")
+    with pytest.raises(OSError):
+        plan.probe_io("checkpoint_save")
+    plan.probe_io("checkpoint_save")  # budget spent: no-op
+    assert [e["fault"] for e in plan.events] == ["io_error", "io_error"]
+
+
+def test_fault_plan_rejects_bad_nan_target():
+    with pytest.raises(ValueError, match="nan_target"):
+        FaultPlan(nan_target="params")
+
+
+def test_tree_all_finite():
+    assert bool(tree_all_finite({"a": jnp.ones(3), "b": jnp.zeros(2)}))
+    assert not bool(tree_all_finite({"a": jnp.ones(3), "b": jnp.asarray(jnp.nan)}))
+    assert bool(tree_all_finite({"ints": jnp.arange(3)}))  # non-float leaves ignored
+
+
+# ---------------------------------------------------------------------------
+# numerical guards (fused into compiled_step)
+# ---------------------------------------------------------------------------
+
+
+def test_guard_skips_nan_steps_bit_exactly():
+    """6 guarded steps with NaN injected at 2 and 5 produce EXACTLY the
+    params of a fault-free 4-step run: skip-and-log applies no update and
+    perturbs nothing else."""
+    clean = _clean_params(4)
+    _reset()
+    plan = FaultPlan(nan_steps=(2, 5))
+    acc, model, opt = _guarded_accelerator(plan=plan)
+    step = acc.compiled_step(_loss)
+    for _ in range(6):
+        step(BATCH)
+    guard = acc.resilience.guard
+    guard.check(model, opt)  # flush the final window
+    assert guard.skipped_steps == 2
+    np.testing.assert_array_equal(
+        clean, np.asarray(jax.device_get(model.params["w"]))
+    )
+
+
+def test_guard_detects_loss_nan_target():
+    plan = FaultPlan(nan_steps=(2,), nan_target="loss")
+    acc, model, opt = _guarded_accelerator(plan=plan)
+    step = acc.compiled_step(_loss)
+    losses = [float(step(BATCH)) for _ in range(4)]
+    guard = acc.resilience.guard
+    guard.check(model, opt)
+    assert guard.skipped_steps == 1
+    assert np.isnan(losses[1]) and not np.isnan(losses[3])  # the report is honest
+
+
+def test_guard_escalates_clip_after_bad_step():
+    """For escalate_steps after a bad step the global-norm clip tightens to
+    escalate_clip: with a near-zero escalation the post-NaN updates are
+    frozen, unlike the unescalated control."""
+    plan = FaultPlan(nan_steps=(2,))
+    policy = GuardPolicy(check_every=2, escalate_clip=1e-8, escalate_steps=4)
+    acc, model, opt = _guarded_accelerator(plan=plan, policy=policy)
+    step = acc.compiled_step(_loss)
+    step(BATCH)
+    after_1 = np.asarray(jax.device_get(model.params["w"]))
+    step(BATCH)  # NaN: skipped, escalation armed
+    step(BATCH)  # escalated clip ≈ 0 → update ≈ 0
+    after_3 = np.asarray(jax.device_get(model.params["w"]))
+    np.testing.assert_allclose(after_3, after_1, atol=1e-6)
+    state = {k: int(v) for k, v in jax.device_get(acc.resilience.guard.state).items()}
+    assert state["escalate"] == 3  # armed at 4 on the bad step, one good step since
+    # control: without escalation the step-3 update moves the weights
+    _reset()
+    acc2, model2, opt2 = _guarded_accelerator(plan=FaultPlan(nan_steps=(2,)))
+    step2 = acc2.compiled_step(_loss)
+    step2(BATCH)
+    control_1 = np.asarray(jax.device_get(model2.params["w"]))
+    step2(BATCH)
+    step2(BATCH)
+    control_3 = np.asarray(jax.device_get(model2.params["w"]))
+    assert np.abs(control_3 - control_1).max() > 1e-4
+
+
+def test_guard_restores_last_known_good_after_k_consecutive_bad_steps(tmp_path):
+    """restore_after consecutive bad steps at a check boundary roll params AND
+    opt_state back to the rolling snapshot."""
+    plan = FaultPlan(nan_steps=(3, 4))
+    policy = GuardPolicy(check_every=4, restore_after=2, snapshot_every=1)
+    acc, model, opt = _guarded_accelerator(
+        plan=plan, policy=policy, telemetry_dir=str(tmp_path)
+    )
+    initial = np.asarray(jax.device_get(model.params["w"]))
+    step = acc.compiled_step(_loss)
+    for _ in range(4):  # good, good, NaN, NaN → check at 4 sees consecutive=2
+        step(BATCH)
+    guard = acc.resilience.guard
+    assert guard.restores == 1
+    # the snapshot was armed at step 1 (before any update): restore rolled
+    # the two good steps back too — last KNOWN good, conservatively
+    np.testing.assert_array_equal(
+        initial, np.asarray(jax.device_get(model.params["w"]))
+    )
+    state = {k: int(v) for k, v in jax.device_get(guard.state).items()}
+    assert state["consecutive"] == 0 and state["escalate"] == 0
+    # training continues healthily from the restored state
+    for _ in range(2):
+        step(BATCH)
+    guard.check(model, opt)
+    assert guard.restores == 1
+    np.testing.assert_array_equal(
+        _clean_params_from(initial, 2), np.asarray(jax.device_get(model.params["w"]))
+    )
+    acc.end_training()
+    records = [json.loads(l) for l in open(tmp_path / "telemetry.jsonl")]
+    events = [r.get("event") for r in records if r["kind"] == "resilience"]
+    assert "guard_restore" in events and "guard_skip" in events
+
+
+def _clean_params_from(initial: np.ndarray, n_steps: int) -> np.ndarray:
+    """Fault-free reference continuing from ``initial`` weights."""
+    _reset()
+    acc = Accelerator()
+    model = acc.prepare_model(Tiny(), params={"w": jnp.asarray(initial)})
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    step = acc.compiled_step(_loss)
+    for _ in range(n_steps):
+        step(BATCH)
+    return np.asarray(jax.device_get(model.params["w"]))
+
+
+def test_guard_skipped_time_feeds_goodput_ledger(tmp_path):
+    plan = FaultPlan(nan_steps=(2,))
+    acc, model, opt = _guarded_accelerator(plan=plan, telemetry_dir=str(tmp_path))
+    step = acc.compiled_step(_loss)
+    for _ in range(4):
+        loss = step(BATCH)
+        acc.telemetry.step(loss)
+    acc.resilience.guard.check(model, opt)
+    snapshot = acc.telemetry.goodput.snapshot(acc.telemetry.timer.productive_seconds)
+    assert snapshot["event_counts"].get("guard_skipped") == 1
+
+
+def test_resilience_disabled_is_inert():
+    acc = Accelerator()
+    assert acc.resilience.enabled is False
+    assert acc.resilience.guard is None and acc.resilience.chaos is None
+    model = acc.prepare_model(Tiny(), params=Tiny().init(jax.random.key(0)))
+    acc.prepare_optimizer(optax.sgd(1e-2))
+    step = acc.compiled_step(_loss)
+    assert np.isfinite(float(step(BATCH)))
+    acc.end_training()  # finish() is a no-op, never raises
+
+
+def test_chaos_stall_injects_host_delay():
+    import time as _time
+
+    plan = FaultPlan(stall_steps=(2,), stall_seconds=0.15)
+    acc, model, opt = _guarded_accelerator(plan=plan)
+    step = acc.compiled_step(_loss)
+    step(BATCH)
+    start = _time.perf_counter()
+    step(BATCH)
+    assert _time.perf_counter() - start >= 0.15
+    assert [e["fault"] for e in plan.events] == ["stall"]
+
+
+# ---------------------------------------------------------------------------
+# serving chaos: queue-pressure burst → shedding
+# ---------------------------------------------------------------------------
+
+
+def test_serving_burst_forces_load_shedding():
+    from accelerate_tpu.models import Llama
+    from accelerate_tpu.serving import QueueFull, ServingEngine
+
+    model = Llama("llama-tiny")
+    params = model.init(jax.random.key(0))
+    plan = FaultPlan(serving_burst_step=0, serving_burst_size=3)
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=32, max_queue=2, fault_plan=plan
+    )
+    prompt = np.arange(1, 5, dtype=np.int32)
+    engine.submit(prompt, max_new_tokens=2)
+    engine.step()  # burst fires: 3 synthetic requests bypass admission
+    assert [e["fault"] for e in plan.events] == ["serving_burst"]
+    assert engine.scheduler.waiting >= 3
+    with pytest.raises(QueueFull) as exc_info:
+        engine.submit(prompt, max_new_tokens=2)
+    assert exc_info.value.retry_after_s > 0
+    results = engine.run()  # the burst drains; the engine stays healthy
+    assert engine.stats.requests_completed >= 4
+    assert all(r.finish_reason in ("length", "eos") for r in results.values())
+
+
+# ---------------------------------------------------------------------------
+# the chaos end-to-end acceptance run
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_end_to_end_nan_io_sigterm_resume(tmp_path, monkeypatch):
+    """The acceptance scenario: a 12-step training run absorbs 2 NaN steps,
+    1 transient checkpoint-save failure, and a SIGTERM — and finishes with
+    EXACTLY the weights of a fault-free 10-step run, via a bit-exact resume,
+    with every event visible as a resilience record in telemetry.jsonl."""
+    monkeypatch.setattr("accelerate_tpu.utils.memory.time.sleep", lambda s: None)
+    telemetry_dir = str(tmp_path / "telemetry")
+    ckpt_dir = str(tmp_path / "ckpts")
+    TOTAL, SIGTERM_AT = 12, 5
+
+    # ---- phase 1: NaN at step 3, SIGTERM at step 5, the preemption save's
+    # manifest write hits one injected transient EIO and must retry through it
+    plan1 = FaultPlan(nan_steps=(3,), sigterm_step=SIGTERM_AT, io_failures=1)
+    acc, model, opt = _guarded_accelerator(plan=plan1, telemetry_dir=telemetry_dir)
+    step = acc.compiled_step(_loss)
+    with CheckpointManager(acc, checkpoint_dir=ckpt_dir) as manager:
+        last = 0
+        for i in range(1, TOTAL + 1):
+            loss = step(BATCH)
+            acc.telemetry.step(loss)
+            last = i
+            if manager.save_on_preemption(step=i):
+                break
+    assert last == SIGTERM_AT  # the SIGTERM ended the run at its boundary save
+    assert manager.exit_requested
+    injected = [e["fault"] for e in plan1.events]
+    assert injected == ["nan", "sigterm", "io_error"]
+    # the save retried through the injected failure and committed verifiably
+    target = os.path.join(ckpt_dir, f"checkpoint_{SIGTERM_AT}")
+    assert verify_checkpoint(target) == []
+    phase1_final = np.asarray(jax.device_get(model.params["w"]))
+    acc.end_training()
+
+    # ---- phase 2: auto-resume, then NaN at global step 7 (local step 2)
+    _reset()
+    plan2 = FaultPlan(nan_steps=(2,))
+    acc2, model2, opt2 = _guarded_accelerator(plan=plan2, telemetry_dir=telemetry_dir)
+    # junk init on purpose: resume must overwrite it bit-exactly
+    model2.params = {"w": jnp.zeros_like(model2.params["w"])}
+    manager2 = CheckpointManager(acc2, checkpoint_dir=ckpt_dir, handle_signals=())
+    resume = manager2.resume("auto")
+    assert resume is not None and resume.step == SIGTERM_AT
+    np.testing.assert_array_equal(
+        phase1_final, np.asarray(jax.device_get(model2.params["w"]))
+    )  # bit-exact resume
+    step2 = acc2.compiled_step(_loss)
+    for i in range(SIGTERM_AT + 1, TOTAL + 1):
+        loss = step2(BATCH)
+        acc2.telemetry.step(loss)
+    guard2 = acc2.resilience.guard
+    guard2.check(model2, opt2)
+    faulty_final = np.asarray(jax.device_get(model2.params["w"]))
+    faulty_loss = float(_loss(jax.device_get(model2.params), np.asarray(BATCH)))
+    acc2.end_training()
+
+    # ---- the invariant: 12 faulty steps with 2 skips == 10 clean steps
+    skips = acc2.resilience.guard.skipped_steps + 1  # phase2 + phase1's one skip
+    assert skips == 2
+    _reset()
+    clean_final = _clean_params(TOTAL - skips)
+    np.testing.assert_array_equal(clean_final, faulty_final)
+    clean_loss = float(_loss({"w": jnp.asarray(clean_final)}, np.asarray(BATCH)))
+    assert clean_loss == faulty_loss
+
+    # ---- telemetry.jsonl carries the whole story as resilience records
+    records = [json.loads(l) for l in open(os.path.join(telemetry_dir, "telemetry.jsonl"))]
+    res = [r for r in records if r["kind"] == "resilience"]
+    faults = [r for r in res if r.get("event") == "fault_injected"]
+    assert sum(1 for r in faults if r["fault"] == "nan") == 2
+    assert sum(1 for r in faults if r["fault"] == "io_error") == 1
+    assert sum(1 for r in faults if r["fault"] == "sigterm") == 1
+    skip_records = [r for r in res if r.get("event") == "guard_skip"]
+    assert sum(r["count"] for r in skip_records) == 2  # matches the injection plan
+    assert any(r.get("event") == "retry" for r in res)  # the backoff was recorded
+    assert any(r.get("event") == "summary" for r in res)

@@ -6,6 +6,7 @@ backend, so the compile/jit-cache invariants proven here are the TPU ones.
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -177,6 +178,189 @@ def test_admission_control_queue_full(llama):
         engine.submit(_prompts([3])[0], max_new_tokens=2)
     assert engine.stats.requests_rejected == 1
     engine.run()
+
+
+def test_queue_full_carries_depth_and_retry_after(llama):
+    """Satellite: a shed request gets actionable guidance — the queue depth
+    at rejection and a retry_after estimate from the measured service rate."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32, max_queue=2)
+    for _ in range(2):
+        engine.submit(_prompts([3])[0], max_new_tokens=2)
+    with pytest.raises(QueueFull) as exc_info:
+        engine.submit(_prompts([3])[0], max_new_tokens=2)
+    e = exc_info.value
+    assert e.queue_depth == 2
+    assert e.retry_after_s is not None and e.retry_after_s > 0
+    assert "retry in" in str(e)
+    engine.run()
+    # with service history the hint tracks the measured rate, still positive
+    for _ in range(2):
+        engine.submit(_prompts([3])[0], max_new_tokens=2)
+    with pytest.raises(QueueFull) as exc_info:
+        engine.submit(_prompts([3])[0], max_new_tokens=2)
+    assert exc_info.value.retry_after_s > 0
+    engine.run()
+
+
+# -- degradation (resilience PR) ----------------------------------------------
+
+
+def test_expired_queued_request_sheds_without_ever_taking_a_slot(llama):
+    """A queued request past its deadline is retired at the top of the next
+    step — it never consumes a prefill or a slot."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    live = engine.submit(_prompts([4], seed=20)[0], max_new_tokens=3)
+    doomed = engine.submit(_prompts([4], seed=21)[0], max_new_tokens=3, deadline_s=0.0)
+    results = engine.run()
+    assert results[doomed].finish_reason == "expired"
+    assert results[doomed].generated.size == 0
+    assert results[live].finish_reason == "length"
+    assert engine.stats.requests_expired == 1
+    # the live request was the only one ever decoded
+    assert engine.stats.steps == 3
+
+
+def test_expired_active_request_frees_slot_by_next_step(llama):
+    """An ACTIVE request whose deadline passes is retired at the top of the
+    next step, and its slot serves the queue immediately."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    a = engine.submit(_prompts([4], seed=22)[0], max_new_tokens=8)
+    b = engine.submit(_prompts([5], seed=23)[0], max_new_tokens=2)
+    engine.step()  # A admitted + one decode
+    engine.scheduler.slots[0].deadline_s = 0.0  # deterministic expiry, no sleeps
+    results = {}
+    while engine.busy:
+        for r in engine.step():
+            results[r.request_id] = r
+    assert results[a].finish_reason == "expired"
+    assert 1 <= results[a].generated.size < 8  # partial output survives
+    assert results[b].finish_reason == "length"
+    assert len(results[b].generated) == 2
+    # A decoded once, B twice — the expired slot never burned another step
+    assert engine.stats.steps == 3
+
+
+def test_cancel_queued_and_active_requests(llama):
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    active = engine.submit(_prompts([4], seed=24)[0], max_new_tokens=8)
+    queued = engine.submit(_prompts([4], seed=25)[0], max_new_tokens=8)
+    engine.step()
+    assert engine.cancel(queued)   # still waiting
+    assert engine.cancel(active)   # mid-decode
+    assert not engine.cancel(9999)  # unknown id
+    results = {}
+    while engine.busy:
+        for r in engine.step():
+            results[r.request_id] = r
+    assert results[active].finish_reason == "cancelled"
+    assert results[queued].finish_reason == "cancelled"
+    assert engine.stats.requests_cancelled == 2
+    # the engine is healthy afterwards: a fresh request completes normally
+    out = engine.generate_many([_prompts([3], seed=26)[0]], max_new_tokens=2)
+    assert len(out) == 1
+
+
+def test_quarantine_requeue_and_probe_release(llama):
+    """A slot producing non-finite logits is quarantined, its request requeues
+    and completes correctly in a clean admission; the slot re-enters
+    circulation only after the finite-logits probe passes."""
+    import jax.numpy as jnp
+
+    model, params = llama
+    prompt = _prompts([5], seed=27)[0]
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    rid = engine.submit(prompt, max_new_tokens=4)
+    engine.step()  # admit + first decode (healthy)
+    # poison the slot's whole K cache: next decode's logits go non-finite
+    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    results = engine.run()
+    assert engine.stats.slot_quarantines == 1
+    assert engine.stats.requests_requeued == 1
+    assert engine.stats.slot_quarantine_releases == 1
+    assert engine.cache.quarantined == frozenset()
+    # the requeued request restarted from its prompt and finished correctly:
+    # greedy output matches the sequential reference exactly
+    expected = np.asarray(
+        generate(model, params, prompt[None], max_new_tokens=4)
+    )[0][prompt.size:]
+    np.testing.assert_array_equal(results[rid].generated, expected)
+    assert results[rid].finish_reason == "length"
+
+
+def test_quarantined_slot_never_serves_until_probe_passes(llama):
+    """While a slot is quarantined it is invisible to admission: with every
+    slot quarantined, a waiting request stays queued until the probe passes."""
+    import jax.numpy as jnp
+
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    engine.submit(_prompts([4], seed=28)[0], max_new_tokens=2)
+    engine.step()
+    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    engine.step()  # quarantine fires; request back at queue head
+    assert engine.cache.quarantined == frozenset({0})
+    assert engine.scheduler.waiting == 1
+    assert engine.scheduler.active_slots == []
+    engine.step()  # probe-only step: slot released at the end
+    assert engine.cache.quarantined == frozenset()
+    assert engine.scheduler.waiting == 1  # admission happens NEXT step
+    results = engine.run()
+    assert all(r.finish_reason == "length" for r in results.values())
+
+
+def test_request_fails_after_max_requeues_instead_of_livelocking(llama):
+    """A request that keeps landing in quarantined slots (e.g. its own input
+    drives the model non-finite) fails after max_request_requeues instead of
+    requeue-cycling forever — run() terminates and everyone else is served."""
+    import jax.numpy as jnp
+
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32)
+    rid = engine.submit(_prompts([4], seed=30)[0], max_new_tokens=4)
+    engine.step()
+    # simulate a request already bounced through bad slots up to the cap
+    engine.scheduler.slots[0].requeues = engine.max_request_requeues
+    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    results = engine.run()
+    assert results[rid].finish_reason == "failed"
+    assert engine.stats.requests_failed == 1
+    assert engine.stats.requests_requeued == 0  # failed, not requeued again
+    # engine stays healthy: the slot probed back and serves new requests
+    out = engine.generate_many([_prompts([3], seed=31)[0]], max_new_tokens=2)
+    assert len(out) == 1
+
+
+def test_watchdog_reports_oversized_step(llama):
+    """A decode step exceeding step_timeout_s is reported (stats counter) even
+    when it completes — the synchronous arm of the watchdog."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=1, max_len=32, step_timeout_s=1e-9)
+    engine.generate_many([_prompts([3], seed=29)[0]], max_new_tokens=2)
+    assert engine.stats.watchdog_trips >= 1
+    assert "watchdog_trips" in engine.metrics()
+
+
+def test_step_watchdog_thread_fires_on_hang():
+    """The wall-clock arm: a step that never returns is reported from the
+    side thread while the 'host' (this test) is still blocked."""
+    from accelerate_tpu.serving.engine import StepWatchdog
+
+    trips = []
+    watchdog = StepWatchdog(0.05, trips.append, poll_s=0.01)
+    try:
+        watchdog.arm()
+        deadline = time.monotonic() + 2.0
+        while not trips and time.monotonic() < deadline:
+            time.sleep(0.01)  # the "hung" step
+        assert trips, "watchdog never fired on a hung step"
+        assert len(trips) == 1  # one trip per armed step
+        watchdog.disarm()
+    finally:
+        watchdog.close()
 
 
 def test_submit_validates_capacity(llama):
