@@ -950,6 +950,71 @@ class Accelerator:
                 self.telemetry.timer.discard_window()
 
     # ------------------------------------------------------------------
+    # program analysis (analysis/: the correctness-tooling layer)
+    # ------------------------------------------------------------------
+
+    def _sharding_intent(self) -> bool:
+        """Whether the user configured model-state sharding — if so, a large
+        parameter resolving to full replication is a regression (ERROR), not
+        the expected data-parallel layout (INFO)."""
+        p = getattr(self.state, "parallelism", None)
+        if p is None:
+            return False
+        model_axes = (p.fsdp, p.pipeline, p.expert, p.sequence, p.tensor)
+        return any(int(size or 1) > 1 for size in model_axes)
+
+    def analyze(
+        self,
+        loss_fn: Optional[Callable] = None,
+        batch: Any = None,
+        *,
+        step: Optional[Callable] = None,
+        model: Optional[PreparedModel] = None,
+        compile: bool = True,
+        label: str = "compiled_step",
+        write_record: bool = True,
+        **audit_kwargs,
+    ):
+        """Audit the fused step program (docs/analysis.md).
+
+        Lowers the exact program ``compiled_step`` runs — pass either a
+        ``step`` previously returned by :meth:`compiled_step`, or the same
+        ``loss_fn`` you would hand it — plus one representative ``batch``
+        (real arrays or ``jax.ShapeDtypeStruct``), and runs the full program
+        audit: donation aliasing, fp64 leaks, baked-in constants, collective
+        inventory, replication. Returns an
+        :class:`~.analysis.AnalysisReport`; the summary also lands as a
+        ``{"kind": "analysis"}`` record in ``telemetry.jsonl``.
+
+        ``compile=True`` (default) compiles a second AOT executable so the
+        post-GSPMD properties (real collectives, executable alias table) are
+        audited — costs one extra XLA compile of the step.
+        """
+        from .analysis import audit_lowered
+
+        if step is None:
+            if loss_fn is None:
+                raise ValueError("analyze() needs a loss_fn (or a step= from compiled_step)")
+            step = self.compiled_step(loss_fn, model=model)
+        if not hasattr(step, "lower"):
+            raise ValueError(
+                "analyze() needs the step returned by compiled_step() (it "
+                "carries the program); got a plain callable."
+            )
+        if batch is None:
+            raise ValueError("analyze() needs a representative batch (arrays or ShapeDtypeStructs)")
+        report = audit_lowered(
+            step.lower(batch),
+            compile=compile,
+            label=label,
+            sharded_intent=audit_kwargs.pop("sharded_intent", self._sharding_intent()),
+            **audit_kwargs,
+        )
+        if write_record and self.telemetry.enabled:
+            self.telemetry.write_record("analysis", {"analysis": report.to_dict()})
+        return report
+
+    # ------------------------------------------------------------------
     # fused fast path
     # ------------------------------------------------------------------
 
@@ -1108,6 +1173,26 @@ class Accelerator:
 
         jitted = jax.jit(guarded_step_impl if res_on else step_impl, donate_argnums=(0, 1))
 
+        def lower(batch):
+            """AOT-lower the fused program against the LIVE params/opt_state —
+            the program-audit entry point (``Accelerator.analyze``): traces
+            the exact program ``step`` runs, without executing a step."""
+            scale_in = optimizer.scale if scaler_cfg is not None else None
+            growth_in = optimizer.growth_tracker if scaler_cfg is not None else None
+            opt_state_in = optimizer.opt_state
+            if optimizer.cpu_offload:
+                opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)
+            if res_on:
+                gstate_in = (
+                    guard.state
+                    if guard is not None and guard.state is not None
+                    else zero_guard_state()
+                )
+                return jitted.lower(
+                    model.params, opt_state_in, batch, scale_in, growth_in, gstate_in, np.int32(0)
+                )
+            return jitted.lower(model.params, opt_state_in, batch, scale_in, growth_in)
+
         def step(batch):
             # no scaler → scale stays a STATIC None (empty pytree through jit):
             # every scaling op is elided at trace time instead of shipping a
@@ -1117,6 +1202,11 @@ class Accelerator:
             opt_state_in = optimizer.opt_state
             if optimizer.cpu_offload:
                 opt_state_in = jax.device_put(opt_state_in, optimizer._opt_state_device_shardings)
+            if optimizer.telemetry is not None:
+                # abstract signature (shapes/dtypes only — no host sync): when
+                # the hub later observes a steady-state recompile, the diff of
+                # the last two signatures names the leaf that forced it
+                optimizer.telemetry.note_step_signature(batch)
             if res_on:
                 step_idx = resilience.begin_step()  # chaos stall/SIGTERM fire here
                 corrupt = np.int32(0)
@@ -1152,6 +1242,11 @@ class Accelerator:
                 guard.after_step(model, optimizer)
             return loss
 
+        # analysis seam: the returned step carries its program (analysis/
+        # program.py audits the jitted fn via lower(); tests pin donation)
+        step.jitted = jitted
+        step.lower = lower
+        step.donate_argnums = (0, 1)
         return step
 
     # ------------------------------------------------------------------

@@ -1,0 +1,55 @@
+"""Static analysis for compiled step and decode programs.
+
+Three passes over three layers of the stack, one report shape:
+
+- :mod:`.program` — jaxpr/HLO audit of a ``jax.stages.Lowered``/``Compiled``
+  program: donation aliasing, fp64 leaks, baked-in constants, the collective
+  inventory, and sharding-resolved-to-replication. Reached via
+  ``Accelerator.analyze()`` / ``ServingEngine.analyze()``.
+- :mod:`.sanitizer` — runtime hazard watcher for warm-loop windows: implicit
+  device→host syncs, steady-state recompiles (with ``explain_recompile``
+  signature diffs), jit-cache misses.
+- :mod:`.lint` — AST lint of user step functions (and this repo's own code)
+  for trace-time hazards: branching on traced values, wall clocks, host RNG,
+  host materialization, captured-state mutation.
+
+CLI: ``accelerate-tpu analyze`` (commands/analyze.py). Findings catalog:
+docs/analysis.md.
+"""
+
+from .findings import CATALOG, ERROR, INFO, WARNING, AnalysisReport, Finding
+from .lint import lint_file, lint_paths, lint_source
+from .program import (
+    audit_lowered,
+    collective_inventory,
+    constant_audit,
+    donation_audit,
+    donation_drop_warning,
+    dtype_audit,
+    flatten_args_info,
+    replication_audit,
+)
+from .sanitizer import HazardSanitizer, explain_recompile, signature_of
+
+__all__ = [
+    "CATALOG",
+    "ERROR",
+    "INFO",
+    "WARNING",
+    "AnalysisReport",
+    "Finding",
+    "HazardSanitizer",
+    "audit_lowered",
+    "collective_inventory",
+    "constant_audit",
+    "donation_audit",
+    "donation_drop_warning",
+    "dtype_audit",
+    "explain_recompile",
+    "flatten_args_info",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "replication_audit",
+    "signature_of",
+]

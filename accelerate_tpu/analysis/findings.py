@@ -1,0 +1,314 @@
+"""Findings: the analyzer's unit of output.
+
+Every pass — the compiled-program audit (program.py), the runtime hazard
+sanitizer (sanitizer.py), and the source lint (lint.py) — emits the same
+:class:`Finding` shape, so one :class:`AnalysisReport` can gate CI, diff
+across commits, land in ``telemetry.jsonl``, and render for humans.
+
+The catalog below is the single source of truth for finding IDs: severity
+defaults, one-line descriptions, and fix hints all live here (docs/analysis.md
+renders from the same entries, tests assert the two never drift).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# severity ladder; ERROR findings gate CI (see tests/test_analysis.py self-gate)
+INFO = "info"
+WARNING = "warning"
+ERROR = "error"
+_SEVERITY_ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+@dataclass
+class CatalogEntry:
+    code: str
+    severity: str
+    title: str
+    fix_hint: str
+    example: str = ""
+
+
+# -- the findings catalog (docs/analysis.md renders this) ---------------------
+
+CATALOG: dict[str, CatalogEntry] = {
+    entry.code: entry
+    for entry in [
+        # program audit (program.py)
+        CatalogEntry(
+            "DONATION_DROPPED", ERROR,
+            "A donated buffer was not aliased to any output",
+            "Make the donated input's shape/dtype/sharding match an output, or "
+            "drop it from donate_argnums — XLA silently keeps both copies live.",
+            "donate_argnums=(0,) but the compiled program aliases 0 of 1 donated buffers",
+        ),
+        CatalogEntry(
+            "DONATION_DISABLED", INFO,
+            "Donation was declared but is disabled for this backend",
+            "Expected on backends without buffer donation; verify on TPU/GPU "
+            "where the HBM saving is real.",
+            "ServingEngine built with donation off on the cpu backend",
+        ),
+        CatalogEntry(
+            "DONATION_NONE", INFO,
+            "No buffers are donated by this program",
+            "Donate the params/opt_state (or KV cache) arguments that the "
+            "program overwrites — halves steady-state HBM traffic for them.",
+            "a step program rebuilt without donate_argnums",
+        ),
+        CatalogEntry(
+            "FP64_LEAK", ERROR,
+            "The program computes in float64/complex128",
+            "Find the leaf or literal that upcast (np scalars default to f64) "
+            "and cast to f32/bf16; TPUs emulate f64 at ~1/10 throughput.",
+            "tensor<4x4xf64> in the lowered StableHLO",
+        ),
+        CatalogEntry(
+            "LARGE_CONSTANT", WARNING,
+            "A large constant is baked into the program",
+            "Pass the array as an argument instead of closing over it: baked "
+            "constants bloat the executable, re-upload on every recompile, and "
+            "defeat donation.",
+            "a 64 MiB embedding table captured by the jitted step",
+        ),
+        CatalogEntry(
+            "REPLICATED_PARAM", ERROR,
+            "A large parameter's sharding resolved to full replication",
+            "Add a partition rule (or with_sharding_constraint) for this leaf — "
+            "one missing annotation makes GSPMD replicate it on every device.",
+            "params['layers']['mlp']['w'] (512 MiB) fully replicated on an 8-way mesh",
+        ),
+        CatalogEntry(
+            "REPLICATED_PARAM_INFO", INFO,
+            "A large parameter is fully replicated (no sharding intent declared)",
+            "Expected under pure data parallelism; listed so the report diffs "
+            "when a sharding config regresses to replication.",
+            "bert params replicated under the default data-parallel mesh",
+        ),
+        # runtime sanitizer (sanitizer.py)
+        CatalogEntry(
+            "HOST_SYNC", ERROR,
+            "A device→host sync happened inside a warm-loop window",
+            "Remove the .item()/float()/np.asarray() from the hot loop (batch "
+            "reads onto the sampling cadence, or keep values on device).",
+            "float(loss) every step stalls the async dispatch pipeline",
+        ),
+        CatalogEntry(
+            "WARM_RECOMPILE", ERROR,
+            "A compile happened after the warm-loop window started",
+            "The signature diff names the leaf that retraced — stabilize its "
+            "shape/dtype (pad to buckets) or mark it static.",
+            "a new batch shape forced a retrace at step 50",
+        ),
+        CatalogEntry(
+            "CACHE_MISS", WARNING,
+            "A jit-cache miss happened inside a warm-loop window",
+            "A program key changed mid-loop (new temperature, toggled dot_fn); "
+            "warm every variant up front.",
+            "serving decode missed its program cache after warmup",
+        ),
+        CatalogEntry(
+            "H2D_TRANSFER", WARNING,
+            "An implicit host→device transfer happened inside a warm-loop window",
+            "Move the host array to device once outside the loop (device_put) "
+            "instead of re-uploading it every step.",
+            "a numpy mask re-uploaded on every decode step",
+        ),
+        # source lint (lint.py)
+        CatalogEntry(
+            "TRACED_BRANCH", WARNING,
+            "Python branch on a traced value",
+            "if/while on a traced value fails (or silently bakes one path at "
+            "trace time) — use jax.lax.cond/select, or mark the argument static.",
+            "if loss > 0: inside a jitted step function",
+        ),
+        CatalogEntry(
+            "HOST_TIME", ERROR,
+            "Wall-clock call inside traced code",
+            "time.time() freezes to a trace-time constant — time outside the "
+            "jitted function (telemetry.step() already fences correctly).",
+            "time.perf_counter() inside a jitted loss",
+        ),
+        CatalogEntry(
+            "HOST_RANDOM", ERROR,
+            "Python/numpy RNG call inside traced code",
+            "random()/np.random freeze to one trace-time draw — thread a "
+            "jax.random key through the function instead.",
+            "np.random.uniform() inside a jitted augmentation",
+        ),
+        CatalogEntry(
+            "LINT_HOST_SYNC", ERROR,
+            "Host materialization inside traced code",
+            ".item()/.tolist()/np.asarray() on a traced value raises under jit "
+            "(or silently syncs when leaked) — keep the computation in jnp.",
+            "loss.item() inside a jitted step",
+        ),
+        CatalogEntry(
+            "HOST_CAST", WARNING,
+            "float()/int()/bool() cast of a possibly-traced value",
+            "Casting a traced array to a Python scalar raises under jit; if the "
+            "value is a static Python number, waive with a pragma.",
+            "float(scale) inside a jitted update",
+        ),
+        CatalogEntry(
+            "CAPTURED_MUTATION", ERROR,
+            "Mutation of captured state inside traced code",
+            "Writes to globals/nonlocals happen once at trace time, not per "
+            "step — return the new value from the function instead.",
+            "global step_count; step_count += 1 inside a jitted fn",
+        ),
+        CatalogEntry(
+            "CAPTURED_MUTATION_CALL", WARNING,
+            "Mutating method call on a captured object inside traced code",
+            ".append()/.update() on captured containers runs at trace time "
+            "only — accumulate through the carry/return value instead.",
+            "results.append(x) inside a jitted scan body",
+        ),
+        CatalogEntry(
+            "TRACE_PRINT", INFO,
+            "print() inside traced code runs at trace time only",
+            "Use jax.debug.print() to see per-step values, or drop the print.",
+            "print(loss) inside a jitted step prints once, at trace",
+        ),
+        CatalogEntry(
+            "PARSE_ERROR", WARNING,
+            "A file handed to the lint could not be parsed",
+            "Fix the syntax error (or check the interpreter version) — the "
+            "file was not analyzed at all.",
+            "a file using syntax newer than the running Python",
+        ),
+    ]
+}
+
+
+@dataclass
+class Finding:
+    """One analyzer observation.
+
+    ``path`` locates it: a pytree path for program findings (``params/
+    layers/mlp/w``), a ``file:line`` for lint findings, a call-site for
+    runtime hazards. ``data`` carries machine-readable detail (byte counts,
+    signature diffs) for the jsonl sink.
+    """
+
+    code: str
+    message: str
+    severity: str = ""
+    path: Optional[str] = None
+    fix_hint: Optional[str] = None
+    data: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        entry = CATALOG.get(self.code)
+        if not self.severity:
+            self.severity = entry.severity if entry else WARNING
+        if self.fix_hint is None and entry is not None:
+            self.fix_hint = entry.fix_hint
+
+    def to_dict(self) -> dict:
+        out = {"code": self.code, "severity": self.severity, "message": self.message}
+        if self.path:
+            out["path"] = self.path
+        if self.fix_hint:
+            out["fix_hint"] = self.fix_hint
+        if self.data:
+            out["data"] = self.data
+        return out
+
+    def __str__(self) -> str:
+        loc = f" [{self.path}]" if self.path else ""
+        return f"{self.severity.upper():7s} {self.code}{loc}: {self.message}"
+
+
+@dataclass
+class AnalysisReport:
+    """The analyzer's output: findings + the diffable program inventory.
+
+    ``inventory`` holds what is worth diffing across commits even when no
+    finding fires: the collective inventory (counts + bytes per kind), the
+    donation summary, and parameter-size/sharding stats. ``meta`` names the
+    program and the analysis cost.
+    """
+
+    findings: list[Finding] = field(default_factory=list)
+    inventory: dict = field(default_factory=dict)
+    meta: dict = field(default_factory=dict)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    def merge(self, other: "AnalysisReport", prefix: Optional[str] = None) -> None:
+        self.findings.extend(other.findings)
+        if prefix:
+            self.inventory[prefix] = other.inventory
+        else:
+            self.inventory.update(other.inventory)
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    @property
+    def has_errors(self) -> bool:
+        return bool(self.errors)
+
+    def counts(self) -> dict:
+        out = {INFO: 0, WARNING: 0, ERROR: 0}
+        for f in self.findings:
+            out[f.severity] = out.get(f.severity, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.sorted_findings()],
+            "counts": self.counts(),
+            "inventory": self.inventory,
+            "meta": self.meta,
+        }
+
+    def sorted_findings(self) -> list[Finding]:
+        return sorted(
+            self.findings,
+            key=lambda f: (-_SEVERITY_ORDER.get(f.severity, 0), f.code, f.path or ""),
+        )
+
+    def render(self) -> str:
+        """Human-readable report (what the CLI prints)."""
+        lines = []
+        label = self.meta.get("label")
+        if label:
+            lines.append(f"== analysis: {label} ==")
+        counts = self.counts()
+        lines.append(
+            f"{len(self.findings)} findings "
+            f"({counts[ERROR]} error, {counts[WARNING]} warning, {counts[INFO]} info)"
+        )
+        for f in self.sorted_findings():
+            lines.append(f"  {f}")
+            if f.fix_hint and f.severity != INFO:
+                lines.append(f"          fix: {f.fix_hint}")
+        collectives = self.inventory.get("collectives")
+        if collectives:
+            lines.append("  collectives:")
+            for kind, stats in sorted(collectives.items()):
+                mib = stats.get("bytes", 0) / (1 << 20)
+                lines.append(f"    {kind:20s} count={stats['count']:<4d} bytes={mib:.2f} MiB")
+        donation = self.inventory.get("donation")
+        if donation:
+            lines.append(
+                f"  donation: {donation.get('aliased', 0)}/{donation.get('declared', 0)} "
+                f"declared buffers aliased"
+            )
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.render()

@@ -1,0 +1,436 @@
+"""Source lint: Python-side hazards inside traced (jit) code.
+
+The program audit sees what XLA compiled; this pass sees what XLA will
+*never* see — the Python that runs once at trace time and silently bakes a
+wrong constant into every subsequent step. ``time.time()`` freezes to the
+trace timestamp, ``np.random`` draws once, ``.item()`` raises (or syncs),
+``results.append(...)`` fires exactly once, and ``if traced_value:`` either
+raises or specializes one branch forever.
+
+Scope: functions the AST can see entering a traced context —
+
+- decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+- passed by name (or as an inline lambda) to a traced-context wrapper:
+  ``jax.jit``, ``value_and_grad``/``grad``, ``vmap``/``pmap``,
+  ``checkpoint``/``remat``, ``lax.scan``/``cond``/``while_loop``/``fori_loop``,
+  and this repo's ``accelerator.compiled_step``/``accelerator.backward``;
+- any function/lambda nested inside one of the above (nested defs trace too).
+
+Waivers: a trailing ``# accel-lint: disable=CODE[,CODE]`` comment waives that
+line; on a ``def`` line it waives the whole function. ``disable=all`` waives
+every code. Waivers are the commit-reviewed escape hatch — the CI gate
+(tests/test_analysis.py) runs this lint over ``accelerate_tpu/`` and
+``examples/`` and fails on any *unwaived* finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .findings import AnalysisReport, Finding
+
+PRAGMA_RE = re.compile(r"#\s*accel-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# names that put their function-valued arguments into a traced context
+TRACE_WRAPPERS = {
+    "jit", "value_and_grad", "grad", "vmap", "pmap", "checkpoint", "remat",
+    "scan", "cond", "while_loop", "fori_loop", "switch",
+    "compiled_step", "backward",
+}
+_TIME_CALLS = {"time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic",
+               "monotonic_ns", "process_time", "clock"}
+_DATETIME_CALLS = {"now", "utcnow", "today"}
+_SYNC_METHODS = {"item", "tolist"}
+_SYNC_NP_CALLS = {"asarray", "array", "copy"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear", "update",
+             "add", "discard", "setdefault", "popitem"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _callable_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """``np.random.uniform`` -> ["np", "random", "uniform"]."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _is_jit_like(node: ast.AST) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / jax.jit(...) factory form."""
+    if _callable_name(node) == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        fname = _callable_name(node.func)
+        if fname == "partial" and node.args and _is_jit_like(node.args[0]):
+            return True
+        if fname == "jit":
+            return True
+    return False
+
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _Linter:
+    def __init__(self, tree: ast.Module, source: str, filename: str):
+        self.tree = tree
+        self.filename = filename
+        self.lines = source.splitlines()
+        self.waivers = self._collect_waivers()
+        # name -> defs with that name anywhere in the file (over-approximate:
+        # per-file scoping is enough for lint, and a false mark only means a
+        # non-traced function gets held to traced standards — waivable)
+        self.defs_by_name: dict[str, list] = {}
+        # names bound to jax.random in this file (`from jax import random`,
+        # `import jax.random as jrandom`): the canonical keyed-RNG idiom,
+        # which the host-RNG check must never flag
+        self.jax_random_aliases: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, _FuncNode):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "random":
+                        self.jax_random_aliases.add(alias.asname or "random")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.random" and alias.asname:
+                        self.jax_random_aliases.add(alias.asname)
+        self.traced_roots: list = []
+        self.findings: list[Finding] = []
+        self._seen: set[tuple] = set()
+
+    # -- waivers -----------------------------------------------------------
+
+    def _collect_waivers(self) -> dict[int, set]:
+        waivers: dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+                waivers[i] = codes
+        return waivers
+
+    def _waived(self, code: str, lineno: int, root) -> bool:
+        for line in (lineno, getattr(root, "lineno", None)):
+            if line is None:
+                continue
+            codes = self.waivers.get(line)
+            if codes and (code in codes or "ALL" in codes):
+                return True
+        return False
+
+    # -- traced-root discovery ---------------------------------------------
+
+    def _mark(self, node: ast.AST) -> None:
+        """Mark a function-valued expression (Name / Attribute / Lambda /
+        IfExp of those) as entering a traced context."""
+        if isinstance(node, ast.Lambda):
+            self.traced_roots.append(node)
+        elif isinstance(node, ast.IfExp):
+            self._mark(node.body)
+            self._mark(node.orelse)
+        else:
+            name = _callable_name(node)
+            if name:
+                self.traced_roots.extend(self.defs_by_name.get(name, ()))
+
+    def discover(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FuncNode):
+                for decorator in node.decorator_list:
+                    if _is_jit_like(decorator):
+                        self.traced_roots.append(node)
+            if isinstance(node, ast.Call):
+                fname = _callable_name(node.func)
+                if isinstance(node.func, ast.Call) and _is_jit_like(node.func):
+                    # `jax.jit(fn, ...)(data...)`: the inner factory call
+                    # already received the function — the OUTER args are data.
+                    # `jax.jit(static_argnums=...)(fn)` / `partial(jax.jit)(fn)`
+                    # pass no positional fn to the factory, so the outer arg
+                    # IS the function.
+                    inner = node.func
+                    positional = [
+                        a for a in inner.args
+                        if not (_callable_name(inner.func) == "partial" and a is inner.args[0])
+                    ]
+                    if positional:
+                        continue
+                if _is_jit_like(node.func) or fname in TRACE_WRAPPERS:
+                    for arg in node.args:
+                        self._mark(arg)
+                    for kw in node.keywords:
+                        if kw.arg in ("body_fun", "cond_fun", "f", "fun", "loss_fn"):
+                            self._mark(kw.value)
+        # dedupe while preserving order
+        seen: set[int] = set()
+        unique = []
+        for root in self.traced_roots:
+            if id(root) not in seen:
+                seen.add(id(root))
+                unique.append(root)
+        self.traced_roots = unique
+
+    # -- hazard checks ------------------------------------------------------
+
+    def _add(self, code: str, lineno: int, message: str, root, severity: str = "") -> None:
+        key = (self.filename, lineno, code)
+        if key in self._seen or self._waived(code, lineno, root):
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(code, message, severity=severity, path=f"{self.filename}:{lineno}")
+        )
+
+    @staticmethod
+    def _subtree_params(root) -> set:
+        """Parameter names of the root and every nested function — all of
+        them hold traced values when the root runs under jit."""
+        params: set[str] = set()
+        for node in ast.walk(root):
+            if isinstance(node, (*_FuncNode, ast.Lambda)):
+                a = node.args
+                for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                    params.add(arg.arg)
+                for arg in (a.vararg, a.kwarg):
+                    if arg is not None:
+                        params.add(arg.arg)
+        return params
+
+    @staticmethod
+    def _bound_names(root) -> set:
+        """Names bound (assigned / defined / comprehension targets) anywhere
+        in the subtree — mutating THESE is function-local, not captured."""
+        bound = _Linter._subtree_params(root)
+        for node in ast.walk(root):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for target in targets:
+                    for sub in ast.walk(target):
+                        if isinstance(sub, ast.Name):
+                            bound.add(sub.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+            elif isinstance(node, _FuncNode):
+                bound.add(node.name)
+            elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+                for sub in ast.walk(node.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+        return bound
+
+    def _branch_names(self, test: ast.AST) -> set:
+        """Names in a branch test that would make it data-dependent —
+        excluding statically-safe forms: ``x is (not) None``, ``isinstance/
+        hasattr/callable/len(...)``, and ``.shape``/``.ndim``/``.dtype``
+        accesses (all trace-time constants)."""
+        skip: set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare):
+                operands = [node.left, *node.comparators]
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and any(
+                    isinstance(c, ast.Constant) and c.value is None for c in operands
+                ):
+                    for sub in operands:
+                        for s in ast.walk(sub):
+                            skip.add(id(s))
+            elif isinstance(node, ast.Call):
+                if _callable_name(node.func) in {"isinstance", "hasattr", "callable", "len", "getattr"}:
+                    for sub in ast.walk(node):
+                        skip.add(id(sub))
+            elif isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        names = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and id(node) not in skip:
+                names.add(node.id)
+        return names
+
+    def check_root(self, root) -> None:
+        params = self._subtree_params(root)
+        bound = self._bound_names(root)
+        # a mutator call whose result is consumed (`updates, st = tx.update(...)`)
+        # is functional API use, not mutation — only bare statements count
+        statement_calls = {
+            id(stmt.value)
+            for stmt in ast.walk(root)
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+        }
+        for node in ast.walk(root):
+            lineno = getattr(node, "lineno", getattr(root, "lineno", 1))
+            if isinstance(node, (ast.If, ast.While)):
+                traced = self._branch_names(node.test) & params
+                if traced:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    self._add(
+                        "TRACED_BRANCH", node.lineno,
+                        f"python `{kind}` on possibly-traced value(s) "
+                        f"{sorted(traced)} inside jit-traced code",
+                        root,
+                    )
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                self._add(
+                    "CAPTURED_MUTATION", lineno,
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                    f"{', '.join(node.names)} inside jit-traced code mutates "
+                    "host state at trace time only",
+                    root,
+                )
+            elif isinstance(node, ast.Call):
+                self._check_call(node, root, bound, statement_calls)
+
+    def _check_call(self, node: ast.Call, root, bound: set, statement_calls: set) -> None:
+        chain = _attr_chain(node.func)
+        lineno = node.lineno
+        name = _callable_name(node.func)
+        if not chain:
+            chain = [name] if name else []
+        base = chain[0] if chain else None
+        # wall clock
+        if (base == "time" and chain[-1] in _TIME_CALLS) or (
+            base in ("datetime", "dt") and chain[-1] in _DATETIME_CALLS
+        ):
+            self._add(
+                "HOST_TIME", lineno,
+                f"{'.'.join(chain)}() inside jit-traced code is a trace-time "
+                "constant, not a per-step clock",
+                root,
+            )
+        # host RNG (names bound to jax.random are the fix, not the hazard)
+        elif (
+            base == "random" and len(chain) > 1 and base not in self.jax_random_aliases
+        ) or (
+            base in ("np", "numpy", "onp") and len(chain) > 2 and chain[1] == "random"
+        ):
+            self._add(
+                "HOST_RANDOM", lineno,
+                f"{'.'.join(chain)}() inside jit-traced code draws once at "
+                "trace time — thread a jax.random key instead",
+                root,
+            )
+        # host materialization
+        elif name in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
+            self._add(
+                "LINT_HOST_SYNC", lineno,
+                f".{name}() inside jit-traced code raises on a tracer (and "
+                "host-syncs when leaked outside)",
+                root,
+            )
+        elif base in ("np", "numpy", "onp") and len(chain) == 2 and chain[1] in _SYNC_NP_CALLS:
+            self._add(
+                "LINT_HOST_SYNC", lineno,
+                f"{'.'.join(chain)}() inside jit-traced code materializes on "
+                "host — use jnp",
+                root,
+            )
+        elif chain[-2:] == ["jax", "device_get"] or (name == "device_get" and base == "jax"):
+            self._add(
+                "LINT_HOST_SYNC", lineno,
+                "jax.device_get() inside jit-traced code",
+                root,
+            )
+        elif name in ("float", "int", "bool") and isinstance(node.func, ast.Name) and node.args:
+            if isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Call)):
+                self._add(
+                    "HOST_CAST", lineno,
+                    f"{name}(...) inside jit-traced code raises on a traced "
+                    "array (waive if the value is a static Python scalar)",
+                    root,
+                )
+        elif name == "print" and isinstance(node.func, ast.Name):
+            self._add(
+                "TRACE_PRINT", lineno,
+                "print() inside jit-traced code runs at trace time only — "
+                "use jax.debug.print for per-step values",
+                root,
+            )
+        # mutating method on a captured (non-locally-bound) object — only as
+        # a bare statement: a consumed result (optax's `tx.update(...)`) is
+        # functional API use
+        elif (
+            name in _MUTATORS
+            and id(node) in statement_calls
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id not in bound
+        ):
+            self._add(
+                "CAPTURED_MUTATION_CALL", lineno,
+                f"{node.func.value.id}.{name}(...) mutates captured state at "
+                "trace time only",
+                root,
+            )
+
+    def run(self) -> list[Finding]:
+        self.discover()
+        for root in self.traced_roots:
+            self.check_root(root)
+        self.findings.sort(key=lambda f: f.path or "")
+        return self.findings
+
+
+# -- public API ---------------------------------------------------------------
+
+
+def lint_source(source: str, filename: str = "<string>") -> list[Finding]:
+    """Lint one source string; returns unwaived findings."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "PARSE_ERROR", f"could not parse {filename}: {e}",
+                path=f"{filename}:{e.lineno or 1}",
+            )
+        ]
+    return _Linter(tree, source, filename).run()
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), filename=path)
+
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d not in _EXCLUDE_DIRS]
+                for filename in sorted(filenames):
+                    if filename.endswith(".py"):
+                        yield os.path.join(dirpath, filename)
+
+
+def lint_paths(paths: Iterable[str]) -> AnalysisReport:
+    """Lint every ``.py`` under the given files/directories. The report's
+    inventory counts files scanned and traced functions found."""
+    report = AnalysisReport(meta={"label": "lint"})
+    files = 0
+    for path in iter_python_files(paths):
+        files += 1
+        report.extend(lint_file(path))
+    report.inventory = {"files_scanned": files, "findings": len(report.findings)}
+    return report

@@ -1,0 +1,531 @@
+"""Compiled-program audit: what the Python source cannot show.
+
+Every performance promise the framework makes lives inside lowered and
+compiled XLA programs that no amount of Python review can see: whether
+``donate_argnums`` actually aliased (donation drops *silently* on shape or
+sharding mismatch), whether a stray numpy scalar upcast the whole program to
+f64, whether a closure baked a 100 MiB table into the executable, and —
+after GSPMD propagation — which collectives the program really runs and
+which parameters quietly resolved to full replication. This module reads
+the ``jax.stages.Lowered``/``Compiled`` artifacts and turns those properties
+into :class:`~.findings.Finding` records plus a diffable inventory.
+
+Entry point: :func:`audit_lowered`. ``Accelerator.analyze`` and
+``ServingEngine.analyze`` feed it their real step/decode programs.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .findings import ERROR, INFO, WARNING, AnalysisReport, Finding
+
+# -- type parsing (shared by StableHLO `tensor<4x4xf32>` and HLO `f32[4,4]`) --
+
+_DTYPE_BYTES = {
+    "pred": 1, "i1": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "i8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "i16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_STABLEHLO_TYPE = re.compile(r"tensor<((?:[0-9]+x)*)([a-z][a-z0-9]*)>")
+_HLO_TYPE = re.compile(r"\b([a-z][a-z0-9]{1,12})\[([0-9,]*)\]")
+
+
+def _numel(dims: str, sep: str) -> int:
+    n = 1
+    for d in dims.split(sep):
+        if d:
+            n *= int(d)
+    return n
+
+
+def type_bytes(match: "re.Match", stablehlo: bool) -> Optional[int]:
+    """Byte size of one parsed tensor type; None for unknown dtypes (tokens,
+    tuples) so callers can skip rather than miscount."""
+    dims, dtype = (match.group(1), match.group(2)) if stablehlo else (match.group(2), match.group(1))
+    per = _DTYPE_BYTES.get(dtype)
+    if per is None:
+        return None
+    return _numel(dims, "x" if stablehlo else ",") * per
+
+
+def _last_type_bytes(line: str) -> Optional[int]:
+    """Byte size of the last tensor type on a line — for ops, that is the
+    result type in both StableHLO (`... -> tensor<...>` / `: tensor<...>`)
+    and HLO (`%x = f32[...] op(...)` puts the type first, so HLO callers
+    should use :func:`_first_type_bytes` instead)."""
+    matches = list(_STABLEHLO_TYPE.finditer(line))
+    if matches:
+        return type_bytes(matches[-1], stablehlo=True)
+    matches = list(_HLO_TYPE.finditer(line))
+    if matches:
+        return type_bytes(matches[-1], stablehlo=False)
+    return None
+
+
+def _first_type_bytes(line: str) -> Optional[int]:
+    m = _STABLEHLO_TYPE.search(line)
+    if m:
+        return type_bytes(m, stablehlo=True)
+    m = _HLO_TYPE.search(line)
+    if m:
+        return type_bytes(m, stablehlo=False)
+    return None
+
+
+# -- argument metadata --------------------------------------------------------
+
+
+@dataclass
+class ArgLeaf:
+    path: str
+    shape: tuple
+    dtype: str
+    donated: bool
+
+    @property
+    def nbytes(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        try:
+            import numpy as np
+
+            return n * np.dtype(self.dtype).itemsize
+        except Exception:
+            return n
+
+
+def _keystr(path) -> str:
+    import jax
+
+    try:
+        s = jax.tree_util.keystr(path)
+    except Exception:
+        s = "".join(str(p) for p in path)
+    # "['params']['w']" -> "params/w", ".attr[0]" -> "attr/0"
+    s = re.sub(r"\[['\"]?([^'\"\]]*)['\"]?\]", r"/\1", s).replace(".", "/")
+    return s.strip("/") or "<arg>"
+
+
+def flatten_args_info(lowered) -> list[ArgLeaf]:
+    """Flatten ``Lowered.args_info`` (the (args, kwargs) pytree of ArgInfo)
+    into path-labelled leaves — the analyzer's view of the program's inputs."""
+    import jax
+
+    leaves = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated")
+    )
+    for path, info in flat:
+        leaves.append(
+            ArgLeaf(
+                path=_keystr(path),
+                shape=tuple(getattr(info, "shape", ())),
+                dtype=str(getattr(info, "dtype", "")),
+                donated=bool(getattr(info, "donated", False)),
+            )
+        )
+    return leaves
+
+
+# -- donation audit -----------------------------------------------------------
+
+
+def _signature_alias_spans(text: str) -> Optional[list[bool]]:
+    """Per-parameter "did the donation survive lowering" flags from the
+    StableHLO main signature. jax emits one of two markers: ``tf.aliasing_
+    output`` (aliasing resolved statically — single-device programs) or
+    ``jax.buffer_donor`` (donation alive, pairing deferred to XLA — the mesh
+    path). A donated parameter with *neither* was dropped at lowering (shape/
+    dtype matched no output). Returns None when the signature cannot be
+    delimited."""
+    starts = []
+    i = 0
+    while True:
+        pos = text.find(f"%arg{i}:")
+        if pos == -1:
+            break
+        starts.append(pos)
+        i += 1
+    if not starts:
+        return []
+    end = text.find("->", starts[-1])
+    if end == -1:
+        return None
+    flags = []
+    for j, start in enumerate(starts):
+        stop = starts[j + 1] if j + 1 < len(starts) else end
+        span = text[start:stop]
+        flags.append("tf.aliasing_output" in span or "jax.buffer_donor" in span)
+    return flags
+
+
+def _executable_alias_entries(compiled_text: str) -> Optional[int]:
+    """Number of parameter→output aliases the backend actually kept, from the
+    executable's ``input_output_alias={ {0}: (0, {}, may-alias), ... }``
+    header (balanced-brace scan — entries contain nested braces)."""
+    start = compiled_text.find("input_output_alias={")
+    if start == -1:
+        return None
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for end in range(i, min(len(compiled_text), i + 1_000_000)):
+        ch = compiled_text[end]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    body = compiled_text[i:end + 1]
+    return body.count("alias")  # may-alias | must-alias, one per entry
+
+
+def donation_audit(
+    lowered,
+    compiled=None,
+    label: str = "program",
+    expect_donation: bool = True,
+) -> tuple[list[Finding], dict]:
+    """Verify declared ``donate_argnums`` actually alias outputs.
+
+    Donation drops *silently*: a donated input whose shape/dtype/sharding
+    matches no output keeps both buffers live (the exact HBM the caller
+    thought they saved), and jax's only signal is a warning easily lost in
+    startup noise. The lowered text is ground truth — jax annotates each
+    donated parameter that survived aliasing with ``tf.aliasing_output`` —
+    and the compiled executable's ``input_output_alias`` + memory analysis
+    confirm what the backend kept.
+    """
+    leaves = flatten_args_info(lowered)
+    donated = [l for l in leaves if l.donated]
+    text = lowered.as_text()
+    flags = _signature_alias_spans(text)
+    lowered_alive = (
+        sum(flags) if flags else text.count("tf.aliasing_output") + text.count("jax.buffer_donor")
+    )
+    summary: dict[str, Any] = {
+        "declared": len(donated),
+        "aliased": min(lowered_alive, len(donated)),
+        "total_args": len(leaves),
+        "declared_bytes": sum(l.nbytes for l in donated),
+    }
+    findings: list[Finding] = []
+    if not donated:
+        if expect_donation:
+            findings.append(
+                Finding(
+                    "DONATION_NONE",
+                    f"{label}: no buffers are donated — steady-state HBM holds "
+                    "two copies of every state tensor",
+                    path=label,
+                )
+            )
+        return findings, summary
+
+    if flags is not None and len(flags) == len(leaves):
+        # 1:1 leaf↔parameter mapping (nothing was dropped as unused): name
+        # exactly which donated leaf failed to alias
+        for leaf, aliased in zip(leaves, flags):
+            if leaf.donated and not aliased:
+                findings.append(
+                    Finding(
+                        "DONATION_DROPPED",
+                        f"{label}: donated buffer {leaf.path} "
+                        f"({leaf.shape}, {leaf.dtype}, {leaf.nbytes / (1 << 20):.2f} MiB) "
+                        "is not aliased to any output",
+                        path=leaf.path,
+                        data={"shape": list(leaf.shape), "dtype": leaf.dtype, "bytes": leaf.nbytes},
+                    )
+                )
+    elif lowered_alive < len(donated):
+        findings.append(
+            Finding(
+                "DONATION_DROPPED",
+                f"{label}: only {lowered_alive} of {len(donated)} donated buffers "
+                "survived lowering (argument mapping unavailable — some inputs "
+                "were dropped as unused, itself a donation smell)",
+                path=label,
+                data={"declared": len(donated), "aliased": lowered_alive},
+            )
+        )
+
+    if compiled is not None:
+        # the executable is ground truth: `jax.buffer_donor` only means the
+        # donation reached XLA — input_output_alias says what it actually kept
+        comp_text = compiled.as_text() or ""
+        exec_entries = _executable_alias_entries(comp_text)
+        if exec_entries is not None:
+            summary["executable_alias_entries"] = exec_entries
+            summary["aliased"] = min(exec_entries, len(donated))
+            if exec_entries < min(lowered_alive, len(donated)) and not findings:
+                findings.append(
+                    Finding(
+                        "DONATION_DROPPED",
+                        f"{label}: the executable aliased only {exec_entries} of "
+                        f"{len(donated)} donated buffers (donation survived "
+                        "lowering but XLA dropped it — typically an input/output "
+                        "sharding or layout mismatch)",
+                        path=label,
+                        data={"declared": len(donated), "executable_aliases": exec_entries},
+                    )
+                )
+        try:
+            mem = compiled.memory_analysis()
+            summary["alias_bytes"] = int(getattr(mem, "alias_size_in_bytes", 0))
+            summary["argument_bytes"] = int(getattr(mem, "argument_size_in_bytes", 0))
+            summary["output_bytes"] = int(getattr(mem, "output_size_in_bytes", 0))
+            summary["temp_bytes"] = int(getattr(mem, "temp_size_in_bytes", 0))
+        except Exception:
+            pass
+    return findings, summary
+
+
+def donation_drop_warning(declared: int, aliased: int, backend: str) -> Optional[dict]:
+    """The engine-side verdict on a first-compile donation consult: None when
+    donation held (or none was declared), else a payload describing the drop.
+    Pure so the silent-drop branch is unit-testable on any backend."""
+    if declared == 0 or aliased >= declared:
+        return None
+    return {
+        "event": "donation_dropped",
+        "declared": declared,
+        "aliased": aliased,
+        "backend": backend,
+        "message": (
+            f"buffer donation silently dropped: {aliased}/{declared} donated "
+            f"buffers aliased on {backend} — steady-state HBM holds both copies"
+        ),
+    }
+
+
+# -- dtype / constant audits --------------------------------------------------
+
+_WIDE_TYPES = ("f64", "c128")
+
+
+def dtype_audit(text: str, label: str = "program", allow_fp64: bool = False) -> list[Finding]:
+    """Flag f64/c128 leaks: one stray numpy scalar (np defaults to float64)
+    upcasts whole subgraphs, and TPUs emulate f64 at ~1/10 throughput."""
+    findings = []
+    for wide in _WIDE_TYPES:
+        count = len(re.findall(rf"(?:tensor<[0-9x]*{wide}>|\b{wide}\[)", text))
+        if count:
+            findings.append(
+                Finding(
+                    "FP64_LEAK",
+                    f"{label}: {count} {wide} tensors in the lowered program",
+                    severity=INFO if allow_fp64 else ERROR,
+                    path=label,
+                    data={"dtype": wide, "count": count},
+                )
+            )
+    return findings
+
+
+def constant_audit(
+    text: str, label: str = "program", threshold_bytes: int = 1 << 20
+) -> list[Finding]:
+    """Flag large constants baked into the program (a closure-captured array
+    becomes part of the executable: re-uploaded per recompile, never donated,
+    duplicated per program that closes over it)."""
+    findings = []
+    total = 0
+    largest = 0
+    count = 0
+    for line in text.splitlines():
+        if "stablehlo.constant" in line or re.search(r"\bconstant\(", line):
+            nbytes = _first_type_bytes(line) if "stablehlo" not in line else _last_type_bytes(line)
+            if nbytes is None:
+                continue
+            total += nbytes
+            largest = max(largest, nbytes)
+            if nbytes >= threshold_bytes:
+                count += 1
+    if count:
+        findings.append(
+            Finding(
+                "LARGE_CONSTANT",
+                f"{label}: {count} constants >= {threshold_bytes / (1 << 20):.0f} MiB "
+                f"baked into the program (largest {largest / (1 << 20):.1f} MiB, "
+                f"total constant bytes {total / (1 << 20):.1f} MiB)",
+                path=label,
+                data={"count": count, "largest_bytes": largest, "total_bytes": total},
+            )
+        )
+    return findings
+
+
+# -- collective inventory -----------------------------------------------------
+
+# canonical kind -> (stablehlo op substrings, HLO op substrings)
+_COLLECTIVES = {
+    "all_reduce": (("stablehlo.all_reduce",), ("all-reduce(", "all-reduce-start(")),
+    "all_gather": (("stablehlo.all_gather",), ("all-gather(", "all-gather-start(")),
+    "reduce_scatter": (("stablehlo.reduce_scatter",), ("reduce-scatter(",)),
+    "collective_permute": (
+        ("stablehlo.collective_permute",),
+        ("collective-permute(", "collective-permute-start("),
+    ),
+    "all_to_all": (("stablehlo.all_to_all",), ("all-to-all(",)),
+}
+
+
+def collective_inventory(text: str) -> dict[str, dict]:
+    """Count + size every cross-device collective in a program text (HLO or
+    StableHLO). Bytes are the op result size — the payload that rides the
+    interconnect — so a sharding regression (e.g. a new all-gather of a full
+    parameter) shows up as a diffable number, not a vibe."""
+    out: dict[str, dict] = {}
+    for line in text.splitlines():
+        for kind, (shlo_pats, hlo_pats) in _COLLECTIVES.items():
+            if any(p in line for p in shlo_pats):
+                nbytes = _last_type_bytes(line) or 0
+            elif any(p in line for p in hlo_pats):
+                nbytes = _first_type_bytes(line) or 0
+            else:
+                continue
+            entry = out.setdefault(kind, {"count": 0, "bytes": 0})
+            entry["count"] += 1
+            entry["bytes"] += nbytes
+            break
+    return out
+
+
+# -- sharding / replication audit --------------------------------------------
+
+
+def replication_audit(
+    lowered,
+    compiled,
+    label: str = "program",
+    threshold_bytes: int = 1 << 20,
+    sharded_intent: bool = False,
+) -> tuple[list[Finding], dict]:
+    """Flag inputs above ``threshold_bytes`` whose sharding resolved to full
+    replication on a multi-device mesh. GSPMD propagates shardings
+    non-locally: one missing annotation replicates a tensor on every device
+    with no error anywhere (arXiv:2105.04663 §3.3) — the expensive failure
+    mode the Python source cannot show. With ``sharded_intent`` (the caller
+    configured model sharding) these are ERRORs; under pure data parallelism
+    they are inventory (INFO) so the report diffs when a config regresses."""
+    import jax
+
+    leaves = flatten_args_info(lowered)
+    findings: list[Finding] = []
+    summary = {"replicated_large_params": 0, "replicated_bytes": 0}
+    try:
+        in_shardings = compiled.input_shardings
+    except Exception:
+        return findings, summary
+    sharding_leaves = jax.tree_util.tree_leaves(
+        in_shardings, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+    )
+    if len(sharding_leaves) != len(leaves):
+        return findings, summary  # unused-arg dropping broke the 1:1 map
+    for leaf, sharding in zip(leaves, sharding_leaves):
+        if leaf.nbytes < threshold_bytes:
+            continue
+        try:
+            multi_device = len(sharding.device_set) > 1
+            replicated = sharding.is_fully_replicated
+        except Exception:
+            continue
+        if multi_device and replicated:
+            summary["replicated_large_params"] += 1
+            summary["replicated_bytes"] += leaf.nbytes
+            findings.append(
+                Finding(
+                    "REPLICATED_PARAM" if sharded_intent else "REPLICATED_PARAM_INFO",
+                    f"{label}: {leaf.path} ({leaf.nbytes / (1 << 20):.1f} MiB) resolved "
+                    f"to full replication over {len(sharding.device_set)} devices",
+                    path=leaf.path,
+                    data={"bytes": leaf.nbytes, "devices": len(sharding.device_set)},
+                )
+            )
+    return findings, summary
+
+
+# -- the orchestrator ---------------------------------------------------------
+
+
+def audit_lowered(
+    lowered,
+    *,
+    compiled=None,
+    compile: bool = True,
+    label: str = "program",
+    sharded_intent: bool = False,
+    allow_fp64: bool = False,
+    expect_donation: bool = True,
+    constant_threshold_bytes: int = 1 << 20,
+    replication_threshold_bytes: int = 1 << 20,
+) -> AnalysisReport:
+    """Run every program pass over one ``jax.stages.Lowered``.
+
+    With ``compile=True`` (or a pre-built ``compiled``), the post-SPMD
+    executable feeds the collective inventory, the executable-level alias
+    table, and the replication audit — the properties GSPMD only decides at
+    compile time. ``compile=False`` keeps the audit trace-only (donation
+    declaration, dtype, constants) for callers who cannot afford a second
+    XLA compile.
+    """
+    import jax
+
+    report = AnalysisReport()
+    t0 = time.perf_counter()
+    text = lowered.as_text()
+    report.extend(dtype_audit(text, label=label, allow_fp64=allow_fp64))
+    report.extend(constant_audit(text, label=label, threshold_bytes=constant_threshold_bytes))
+    inventory: dict[str, Any] = {}
+
+    compile_s = None
+    if compiled is None and compile:
+        t_c = time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t_c
+    # ONE donation audit, with the executable when available: it carries both
+    # the lowering-level findings and the executable-level drop (XLA keeping
+    # fewer aliases than survived lowering) — both must reach the report
+    findings, donation_summary = donation_audit(
+        lowered, compiled=compiled, label=label, expect_donation=expect_donation
+    )
+    report.extend(findings)
+    inventory["donation"] = donation_summary
+    if compiled is not None:
+        comp_text = compiled.as_text() or ""
+        inventory["collectives"] = collective_inventory(comp_text)
+        repl_findings, repl_summary = replication_audit(
+            lowered,
+            compiled,
+            label=label,
+            threshold_bytes=replication_threshold_bytes,
+            sharded_intent=sharded_intent,
+        )
+        report.extend(repl_findings)
+        inventory["replication"] = repl_summary
+    else:
+        # pre-partitioning StableHLO only names collectives the user wrote
+        # explicitly (shard_map); GSPMD's inserted ones need the executable
+        inventory["collectives"] = collective_inventory(text)
+
+    report.inventory = inventory
+    report.meta = {
+        "label": label,
+        "backend": jax.default_backend(),
+        "num_devices": jax.device_count(),
+        "compiled": compiled is not None,
+        "analysis_seconds": round(time.perf_counter() - t0, 4),
+    }
+    if compile_s is not None:
+        report.meta["compile_seconds"] = round(compile_s, 4)
+    return report

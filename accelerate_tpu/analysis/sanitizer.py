@@ -1,0 +1,373 @@
+"""Runtime hazard sanitizer: catch what static analysis cannot.
+
+The three silent warm-loop killers — implicit device→host syncs, steady-state
+recompiles, and jit-cache misses — leave no trace in Python source and no
+error anywhere; they just stretch step time. :class:`HazardSanitizer` is a
+context manager that watches a *warm window* of a live loop:
+
+    step(batch)                      # warmup: compiles happen here, fine
+    with HazardSanitizer(telemetry=accelerator.telemetry) as san:
+        for batch in loader:         # warm window: nothing may compile/sync
+            step(batch)
+    report = san.report              # findings with call sites
+
+Three fused feeds:
+
+1. **Host syncs** — the jax array type's host-materialization hooks
+   (``__float__``/``__int__``/``__bool__``/``__index__``/``item``/``tolist``
+   plus ``jax.device_get``) are interposed for the window's duration, so a
+   ``loss.item()`` buried three calls deep is caught *with its call site* on
+   every backend — including CPU, where ``jax.transfer_guard`` sees nothing
+   because D2H is zero-copy. (``np.asarray`` reaches the buffer protocol
+   below Python and is only caught when it routes through ``__array__`` or
+   ``device_get``; the lint covers it statically.)
+2. **Recompiles / cache misses** — a private
+   :class:`~..telemetry.compile_tracker.CompileTracker` rides the existing
+   ``jax.monitoring`` + ``utils/jit_cache.cache_event_hook`` dispatcher; any
+   compile or program-cache miss inside the window is a finding.
+3. **H2D re-uploads** (optional) — ``transfer_guard="disallow"`` arms jax's
+   transfer guard for implicit host→device transfers (a numpy array
+   re-uploaded every step); it *raises* at the offending line, so it is off
+   by default.
+
+:func:`explain_recompile` answers the follow-up question a recompile finding
+always raises — *which argument retraced?* — by diffing two abstract
+signatures (shape/dtype per pytree leaf, repr for static leaves) and naming
+exactly the leaves that changed. ``HazardSanitizer.watch(step)`` wraps a step
+callable to capture those signatures per call and attach the diff to the
+finding (and, via the telemetry hub, to the ``{"kind": "compile"}`` record in
+``telemetry.jsonl``).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+from .findings import AnalysisReport, Finding
+
+_HOOK_NAMES = ("__array__", "__float__", "__int__", "__bool__", "__index__", "item", "tolist")
+
+_patch_lock = threading.Lock()
+_patch_depth = 0
+_patch_originals: dict[str, Any] = {}
+_active_sanitizers: list["HazardSanitizer"] = []
+
+
+# -- abstract signatures ------------------------------------------------------
+
+
+def signature_of(tree: Any) -> dict[str, str]:
+    """Abstract signature of a pytree of call arguments: ``path ->
+    "shape/dtype"`` for array leaves, ``repr`` for static leaves (whose value
+    IS part of the trace key). Cheap — no device access, no hashing of data."""
+    import jax
+
+    from .program import _keystr
+
+    out: dict[str, str] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = _keystr(path)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            out[key] = f"{tuple(leaf.shape)}/{leaf.dtype}"
+        else:
+            out[key] = f"static:{leaf!r}"[:120]
+    return out
+
+
+def explain_recompile(before: Optional[dict], after: Optional[dict]) -> dict:
+    """Diff two abstract signatures and name exactly which leaf forced the
+    retrace. Returns ``{changed, added, removed, summary}`` — ``changed``
+    maps pytree paths to ``(old, new)``."""
+    before = before or {}
+    after = after or {}
+    changed = {
+        k: (before[k], after[k]) for k in before if k in after and before[k] != after[k]
+    }
+    added = {k: after[k] for k in after if k not in before}
+    removed = {k: before[k] for k in before if k not in after}
+    if not (changed or added or removed):
+        summary = (
+            "identical abstract signatures — the retrace came from a new "
+            "callable object (fresh lambda/closure per step), not the arguments"
+        )
+    else:
+        parts = []
+        for k, (a, b) in list(changed.items())[:4]:
+            parts.append(f"{k}: {a} -> {b}")
+        for k in list(added)[:2]:
+            parts.append(f"+{k}: {added[k]}")
+        for k in list(removed)[:2]:
+            parts.append(f"-{k}: {removed[k]}")
+        summary = "; ".join(parts)
+    return {"changed": changed, "added": added, "removed": removed, "summary": summary}
+
+
+# -- the host-sync interposer -------------------------------------------------
+
+
+def _user_call_site() -> str:
+    """First stack frame outside jax/numpy/this module — where the sync was
+    *requested*, which is what the user needs to go fix."""
+    here = __file__
+    for frame, lineno in traceback.walk_stack(None):
+        filename = frame.f_code.co_filename
+        if (
+            filename == here
+            or "/jax/" in filename
+            or "/jaxlib/" in filename
+            or "/numpy/" in filename
+        ):
+            continue
+        return f"{filename}:{lineno} ({frame.f_code.co_name})"
+    return "<unknown>"
+
+
+def _site_from_traceback(tb) -> str:
+    """Deepest user frame of an in-flight exception (the transfer-guard trip
+    raises inside jax — walk the traceback down, keep the last non-jax frame)."""
+    site = "<unknown>"
+    while tb is not None:
+        frame = tb.tb_frame
+        filename = frame.f_code.co_filename
+        if not ("/jax/" in filename or "/jaxlib/" in filename or filename == __file__):
+            site = f"{filename}:{tb.tb_lineno} ({frame.f_code.co_name})"
+        tb = tb.tb_next
+    return site
+
+
+def _record_sync(kind: str) -> None:
+    site = _user_call_site()
+    for sanitizer in list(_active_sanitizers):
+        sanitizer._on_host_sync(kind, site)
+
+
+def _install_patches() -> None:
+    global _patch_depth
+    import jax
+
+    with _patch_lock:
+        _patch_depth += 1
+        if _patch_depth > 1:
+            return
+        try:
+            # resolve the concrete array type WITHOUT creating an array: the
+            # caller may already hold jax's transfer guard open, and a probe
+            # jnp.zeros(()) would itself be a disallowed host->device transfer
+            from jax._src.array import ArrayImpl as array_type
+        except ImportError:  # jax moved it: fall back to a probe array
+            array_type = type(jax.numpy.zeros(()))
+        for name in _HOOK_NAMES:
+            original = getattr(array_type, name, None)
+            if original is None:
+                continue
+
+            def make_wrapper(hook_name: str, orig: Any):
+                def wrapper(self, *args, **kwargs):
+                    _record_sync(hook_name)
+                    return orig(self, *args, **kwargs)
+
+                return wrapper
+
+            try:
+                setattr(array_type, name, make_wrapper(name, original))
+            except (TypeError, AttributeError):
+                continue  # backend array type refuses patching: partial coverage
+            _patch_originals[(name)] = (array_type, original)
+        original_get = jax.device_get
+
+        def device_get(x):
+            _record_sync("device_get")
+            return original_get(x)
+
+        jax.device_get = device_get
+        _patch_originals["device_get"] = (jax, original_get)
+
+
+def _remove_patches() -> None:
+    global _patch_depth
+    with _patch_lock:
+        _patch_depth -= 1
+        if _patch_depth > 0:
+            return
+        for name, (owner, original) in _patch_originals.items():
+            try:
+                if name == "device_get":
+                    owner.device_get = original
+                else:
+                    setattr(owner, name, original)
+            except (TypeError, AttributeError):
+                pass
+        _patch_originals.clear()
+
+
+# -- the sanitizer ------------------------------------------------------------
+
+
+class HazardSanitizer:
+    """Warm-window watcher fusing host-sync interposition, compile/cache
+    tracking, and (optionally) jax's transfer guard. See module docstring.
+
+    ``allow`` suppresses finding codes (e.g. ``allow={"CACHE_MISS"}`` for a
+    window that legitimately builds one late program). ``transfer_guard``
+    ("disallow"/"log") additionally arms jax's implicit-H2D guard — note
+    "disallow" raises at the offending transfer rather than recording.
+    """
+
+    def __init__(
+        self,
+        telemetry: Any = None,
+        label: str = "warm-loop",
+        allow: Optional[set] = None,
+        transfer_guard: Optional[str] = None,
+    ):
+        from ..telemetry.compile_tracker import CompileTracker
+
+        self.telemetry = telemetry
+        self.label = label
+        self.allow = set(allow or ())
+        self.transfer_guard = transfer_guard
+        self.compiles = CompileTracker()
+        self.syncs: dict[tuple[str, str], int] = {}  # (kind, site) -> count
+        self.h2d_trips: list[str] = []  # transfer-guard trip sites
+        self.recompile_explanations: list[dict] = []
+        self._active = False
+        self._guard_ctx = None
+        self._last_signature: Optional[dict] = None
+        self._prev_signature: Optional[dict] = None
+
+    # -- window lifecycle --------------------------------------------------
+
+    def __enter__(self) -> "HazardSanitizer":
+        # the (fallible) guard context enters FIRST: a bad level string must
+        # raise before the process-global array patches go in, or a failed
+        # __enter__ (whose __exit__ never runs) would leak them forever
+        if self.transfer_guard:
+            import jax
+
+            self._guard_ctx = jax.transfer_guard_host_to_device(self.transfer_guard)
+            self._guard_ctx.__enter__()
+        try:
+            self.compiles.start()
+            _install_patches()
+            _active_sanitizers.append(self)
+        except BaseException:
+            if self._guard_ctx is not None:
+                self._guard_ctx.__exit__(None, None, None)
+                self._guard_ctx = None
+            raise
+        self._active = True
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self._active = False
+        exc = exc_info[1] if len(exc_info) > 1 else None
+        if exc is not None and "host-to-device" in str(exc):
+            # the transfer guard tripped inside the window: the exception
+            # still propagates (disallow mode aborts the loop by design), but
+            # the report documents the transfer with its site
+            self.h2d_trips.append(_site_from_traceback(exc_info[2]))
+        if self._guard_ctx is not None:
+            self._guard_ctx.__exit__(*exc_info)
+            self._guard_ctx = None
+        if self in _active_sanitizers:
+            _active_sanitizers.remove(self)
+        _remove_patches()
+        self.compiles.stop()
+        if self.telemetry is not None:
+            self.telemetry.write_record(
+                "analysis", {"sanitizer": self.report.to_dict(), "label": self.label}
+            )
+
+    # -- feeds -------------------------------------------------------------
+
+    def _on_host_sync(self, kind: str, site: str) -> None:
+        if not self._active:
+            return
+        key = (kind, site)
+        self.syncs[key] = self.syncs.get(key, 0) + 1
+
+    def watch(self, fn: Callable, label: Optional[str] = None) -> Callable:
+        """Wrap a step callable: capture the abstract signature of every call
+        and, when a call compiled after the first one, attach the signature
+        diff naming the leaf that retraced."""
+        name = label or getattr(fn, "__name__", "step")
+
+        def wrapped(*args, **kwargs):
+            signature = signature_of((args, kwargs))
+            if signature != self._last_signature:
+                self._prev_signature = self._last_signature
+                self._last_signature = signature
+            before = self.compiles.compile_count + self.compiles.cache_misses
+            result = fn(*args, **kwargs)
+            after = self.compiles.compile_count + self.compiles.cache_misses
+            if self._active and after > before and self._prev_signature is not None:
+                explanation = explain_recompile(self._prev_signature, self._last_signature)
+                explanation["callable"] = name
+                self.recompile_explanations.append(explanation)
+            return result
+
+        wrapped.__name__ = f"sanitized_{name}"
+        return wrapped
+
+    # -- readout -----------------------------------------------------------
+
+    @property
+    def report(self) -> AnalysisReport:
+        report = AnalysisReport(meta={"label": self.label})
+        for (kind, site), count in sorted(self.syncs.items()):
+            report.add(
+                Finding(
+                    "HOST_SYNC",
+                    f"{count}x device->host sync via {kind} inside the "
+                    f"{self.label} window",
+                    path=site,
+                    data={"kind": kind, "count": count},
+                )
+            )
+        snapshot = self.compiles.snapshot()
+        if snapshot["compile_count"]:
+            data = dict(snapshot)
+            if self.recompile_explanations:
+                data["explanations"] = self.recompile_explanations
+                detail = "; ".join(
+                    e["summary"] for e in self.recompile_explanations[:2]
+                )
+            else:
+                detail = "wrap the step with .watch() to capture the signature diff"
+            report.add(
+                Finding(
+                    "WARM_RECOMPILE",
+                    f"{snapshot['compile_count']} compiles "
+                    f"({snapshot['compile_seconds']:.2f}s) inside the "
+                    f"{self.label} window — {detail}",
+                    data=data,
+                )
+            )
+        for site in self.h2d_trips:
+            report.add(
+                Finding(
+                    "H2D_TRANSFER",
+                    f"implicit host->device transfer tripped the guard inside "
+                    f"the {self.label} window",
+                    path=site,
+                )
+            )
+        if snapshot["jit_cache_misses"]:
+            report.add(
+                Finding(
+                    "CACHE_MISS",
+                    f"{snapshot['jit_cache_misses']} program-cache misses inside "
+                    f"the {self.label} window",
+                    data={
+                        "misses": snapshot["jit_cache_misses"],
+                        "hits": snapshot["jit_cache_hits"],
+                        "recent_miss_keys": snapshot.get("recent_miss_keys", []),
+                    },
+                )
+            )
+        report.findings = [f for f in report.findings if f.code not in self.allow]
+        report.inventory = {"compiles": snapshot, "host_syncs": sum(self.syncs.values())}
+        return report

@@ -1,0 +1,120 @@
+"""`accelerate-tpu analyze` — the static-analysis front door.
+
+Two modes that compose:
+
+1. **Source lint** (default): AST-lint the given files/directories for
+   trace-time hazards in jit-traced functions — branching on traced values,
+   wall clocks, host RNG, ``.item()``/``np.asarray`` host syncs, captured-
+   state mutation. Exit code 1 on any ERROR finding (``--strict``: on any
+   finding), so the command drops straight into CI::
+
+       accelerate-tpu analyze train.py my_pkg/ --strict
+
+2. **Self-check** (``--self-check``): build the repo's own bert-tiny fused
+   step program and a llama-tiny serving decode program and run the full
+   compiled-program audit (donation aliasing, fp64, constants, collective
+   inventory, replication) over both — the same gate
+   ``tests/test_analysis.py`` enforces, runnable anywhere::
+
+       accelerate-tpu analyze --self-check
+
+``--json`` emits the machine-readable report (findings + inventory) for
+diffing across commits. The findings catalog lives in docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "analyze",
+        help="Static lint + compiled-program audit for step and decode paths",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="Python files or directories to lint (default: none — use --self-check)",
+    )
+    parser.add_argument(
+        "--self-check", action="store_true",
+        help="Audit the repo's own bert-tiny step + llama-tiny decode programs",
+    )
+    parser.add_argument(
+        "--no-compile", action="store_true",
+        help="Self-check: skip the AOT compile (trace-level audit only)",
+    )
+    parser.add_argument("--json", action="store_true", help="Emit the machine-readable report")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="Exit non-zero on ANY finding (default: errors only)",
+    )
+    parser.set_defaults(func=run)
+    return parser
+
+
+def _self_check(compile: bool):
+    """The analyzer pointed at this repo's own hot paths — small configs, so
+    it runs on a laptop CPU in seconds and proves the plumbing end to end."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .. import Accelerator
+    from ..models import Bert, Llama
+    from ..serving import ServingEngine
+
+    reports = []
+    accelerator = Accelerator()
+    model = Bert("bert-tiny")
+    accelerator.prepare_model(model)
+    accelerator.prepare_optimizer(optax.adamw(1e-4))
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, vocab, (8, 16)), jnp.int32),
+        "attention_mask": jnp.ones((8, 16), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 2, (8,)), jnp.int32),
+    }
+    reports.append(
+        accelerator.analyze(
+            Bert.loss_fn(model), batch, compile=compile, label="bert_tiny_step",
+            write_record=False,
+        )
+    )
+
+    llama = Llama("llama-tiny")
+    engine = ServingEngine(llama, llama.init(jax.random.key(0)), num_slots=2, max_len=32)
+    reports.append(
+        engine.analyze(compile=compile, write_record=False)
+    )
+    return reports
+
+
+def run(args) -> int:
+    from ..analysis import AnalysisReport, lint_paths
+
+    reports: list[AnalysisReport] = []
+    if args.paths:
+        reports.append(lint_paths(args.paths))
+    if args.self_check:
+        reports.extend(_self_check(compile=not args.no_compile))
+    if not reports:
+        print("nothing to analyze: pass paths to lint and/or --self-check")
+        return 1
+
+    total_findings = 0
+    total_errors = 0
+    if args.json:
+        print(json.dumps([r.to_dict() for r in reports], indent=2, default=str))
+    for report in reports:
+        if not args.json:
+            print(report.render())
+            print()
+        total_findings += len(report.findings)
+        total_errors += len(report.errors)
+    if total_errors or (args.strict and total_findings):
+        return 1
+    return 0

@@ -208,12 +208,15 @@ class AcceleratedOptimizer:
             # Cost of the static count: one extra compile per DISTINCT count
             # (cached thereafter) — in practice two values, the configured
             # window and the final short bundle of an indivisible epoch
+            # accel-lint waivers: accum_count is STATIC (jit static_argnums=(3,)
+            # below), so the float() casts and the branch run at trace time by
+            # design — exactly what the comment above documents.
             if use_scaler:
-                denom = float(accum_count) * scale
+                denom = float(accum_count) * scale  # accel-lint: disable=HOST_CAST
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
-            elif accum_count != 1:
+            elif accum_count != 1:  # accel-lint: disable=TRACED_BRANCH
                 grads = jax.tree.map(
-                    lambda g: g.astype(jnp.float32) / float(accum_count), grads
+                    lambda g: g.astype(jnp.float32) / float(accum_count), grads  # accel-lint: disable=HOST_CAST
                 )
             else:
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
@@ -234,11 +237,67 @@ class AcceleratedOptimizer:
 
         return jax.jit(update, donate_argnums=(0, 1, 2), static_argnums=(3,))
 
+    # -- donation audit (analysis/program.py) --------------------------------
+
+    def _lower_update(self):
+        """AOT-lower the current update program against live state (grads
+        substituted with zeros when none are accumulated) — the donation
+        audit's view of exactly what ``step()`` runs."""
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        grads = self._grads
+        if grads is None:
+            grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), self._box.value
+            )
+        opt_state = self.opt_state
+        if self.cpu_offload:
+            opt_state = jax.device_put(opt_state, self._opt_state_device_shardings)
+        return self._update_fn.lower(
+            self._box.value, opt_state, grads, int(self._accum_count or 1),
+            self.scale, self.growth_tracker,
+        )
+
+    def verify_donation(self, compile: bool = False):
+        """Audit the eager update program: params/opt_state/grads are donated
+        (``donate_argnums=(0, 1, 2)`` above) and XLA drops any unusable
+        donation *silently* — this verifies the aliases actually held.
+        Returns an :class:`~.analysis.AnalysisReport`."""
+        from .analysis import audit_lowered
+
+        return audit_lowered(self._lower_update(), compile=compile, label="optimizer_update")
+
+    def _consult_donation(self) -> None:
+        """One-shot telemetry consult after the update fn is (re)built: if a
+        declared donation failed to alias, say so where someone will look —
+        the log and telemetry.jsonl — instead of silently doubling HBM.
+        Lowering-level only (an XLA-level drop under a mesh needs the
+        executable: ``verify_donation(compile=True)``)."""
+        try:
+            from .analysis.program import donation_audit, donation_drop_warning
+
+            _, summary = donation_audit(self._lower_update(), label="optimizer_update")
+            warning = donation_drop_warning(
+                summary["declared"], summary["aliased"], jax.default_backend()
+            )
+        except Exception:
+            return  # observability must never take down the update path
+        if warning is not None:
+            from .logging import get_logger
+
+            get_logger(__name__).warning(f"optimizer_update: {warning['message']}")
+            if self.telemetry is not None:
+                self.telemetry.write_record(
+                    "analysis", {"label": "optimizer_update", "level": "lowered", **warning}
+                )
+
     def step(self) -> None:
         if not self.gradient_state.sync_gradients or self._grads is None:
             return
         if self._update_fn is None:
             self._update_fn = self._build_update_fn()
+            if self.telemetry is not None:
+                self._consult_donation()
         if self.cpu_offload:
             # stream offloaded state into device memory for the update (the jit
             # itself stays all-device: mixing memory spaces inside a traced

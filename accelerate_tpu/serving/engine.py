@@ -219,6 +219,7 @@ class ServingEngine:
         self.max_request_requeues = max_request_requeues
         self._probe_failures: dict[int, int] = {}
         self._decode_warm = False  # first decode completed (compile behind us)
+        self._donation_checked = False  # one consult after the first compile
 
     # -- jitted programs (dot-keyed: shared cache with generate()) ----------
 
@@ -249,8 +250,13 @@ class ServingEngine:
             donate = (1, 2) if self._donate else ()
             return jax.jit(decode_step, donate_argnums=donate)
 
+        # _donate is part of the key: engines sharing one model (same program
+        # cache) may differ on backend donation, and a donating program served
+        # where donation was off (or vice versa) is silently wrong
         return self._jit(
-            ("serve_decode", self.cache.num_slots, self.cache.max_len, self.temperature), build
+            ("serve_decode", self.cache.num_slots, self.cache.max_len, self.temperature,
+             self._donate),
+            build,
         )
 
     def _prefill_program(self, bucket: int):
@@ -282,7 +288,9 @@ class ServingEngine:
             donate = (0, 1) if self._donate else ()
             return jax.jit(scrub, donate_argnums=donate)
 
-        return self._jit(("serve_scrub", self.cache.num_slots, self.cache.max_len), build)
+        return self._jit(
+            ("serve_scrub", self.cache.num_slots, self.cache.max_len, self._donate), build
+        )
 
     def _insert_program(self, bucket: int):
         def build():
@@ -294,7 +302,10 @@ class ServingEngine:
             donate = (0, 1) if self._donate else ()
             return jax.jit(insert, donate_argnums=donate)
 
-        return self._jit(("serve_insert", bucket, self.cache.num_slots, self.cache.max_len), build)
+        return self._jit(
+            ("serve_insert", bucket, self.cache.num_slots, self.cache.max_len, self._donate),
+            build,
+        )
 
     def _prefill_cache(self, bucket: int) -> dict:
         """Zero cache template per bucket — jax arrays are immutable, so one
@@ -554,6 +565,12 @@ class ServingEngine:
         ):
             # oversized-but-completed step the poll-based thread missed
             self._on_watchdog_trip(now - t0)
+        if not self._decode_warm:
+            # first decode just compiled: consult the donation audit once —
+            # donation here is enabled only by backend string (self._donate)
+            # and XLA drops an unusable donation silently, so "enabled" and
+            # "working" are different claims until this check
+            self._consult_donation()
         self._decode_warm = True
 
         delivered = 0
@@ -652,6 +669,114 @@ class ServingEngine:
                 )
             out.append(row)
         return out
+
+    # -- program analysis (analysis/: docs/analysis.md) --------------------
+
+    def _lower_decode(self):
+        """AOT-lower the decode program against the live slot cache — the
+        audit's view of exactly the program ``step()`` runs."""
+        keys = jax.random.split(self._rng, self.cache.num_slots)
+        return self._decode_program().lower(
+            self.params,
+            self.cache.k,
+            self.cache.v,
+            self._pending,
+            self.cache.lengths,
+            self.cache.active,
+            keys,
+        )
+
+    def _consult_donation(self) -> None:
+        """Lowering-level check: catches donations dropped at trace time (no
+        marker on the parameter). It cannot see an XLA-level drop — under a
+        mesh the ``jax.buffer_donor`` marker only means the donation *reached*
+        XLA — so records carry ``level: "lowered"``; ``analyze(compile=True)``
+        is the executable-level proof when the extra compile is affordable."""
+        if self._donation_checked or not self._donate:
+            self._donation_checked = True
+            return
+        self._donation_checked = True
+        try:
+            from ..analysis.program import donation_audit, donation_drop_warning
+
+            _, summary = donation_audit(self._lower_decode(), label="serving_decode")
+            warning = donation_drop_warning(
+                summary["declared"], summary["aliased"], jax.default_backend()
+            )
+        except Exception:
+            return  # the consult must never take down the serving loop
+        if warning is not None:
+            from ..logging import get_logger
+
+            get_logger(__name__).warning(f"serving_decode: {warning['message']}")
+            if self.telemetry is not None:
+                self.telemetry.write_record(
+                    "analysis", {"label": "serving_decode", "level": "lowered", **warning}
+                )
+        elif self.telemetry is not None:
+            self.telemetry.write_record(
+                "analysis",
+                {
+                    "label": "serving_decode",
+                    "event": "donation_verified",
+                    "level": "lowered",
+                    "declared": summary["declared"],
+                    "aliased": summary["aliased"],
+                },
+            )
+
+    def analyze(
+        self,
+        compile: bool = True,
+        include_prefill: bool = True,
+        write_record: bool = True,
+        **audit_kwargs,
+    ):
+        """Audit the decode program (and, lowered-only, each prefill-bucket
+        program): donation aliasing, fp64 leaks, baked constants, collective
+        inventory, replication. Returns an
+        :class:`~.analysis.AnalysisReport`; the summary also lands as a
+        ``{"kind": "analysis"}`` record when a telemetry hub is attached.
+
+        ``compile=True`` builds one extra AOT executable of the decode step
+        so post-GSPMD properties are audited. The engine's fixed shapes make
+        this exactly the program every steady-state step runs."""
+        from ..analysis import Finding, audit_lowered
+
+        report = audit_lowered(
+            self._lower_decode(),
+            compile=compile,
+            label="serving_decode",
+            expect_donation=self._donate,
+            **audit_kwargs,
+        )
+        if not self._donate:
+            report.add(
+                Finding(
+                    "DONATION_DISABLED",
+                    f"serving_decode: KV-cache donation is off for backend "
+                    f"{jax.default_backend()!r} — decode HBM traffic doubles "
+                    "vs tpu/gpu",
+                    path="serving_decode",
+                )
+            )
+        if include_prefill:
+            for bucket in self.buckets:
+                ids = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+                lowered = self._prefill_program(bucket).lower(
+                    self.params, ids, self._prefill_cache(bucket)
+                )
+                sub = audit_lowered(
+                    lowered,
+                    compile=False,
+                    label=f"serving_prefill_{bucket}",
+                    expect_donation=False,
+                    **audit_kwargs,
+                )
+                report.merge(sub, prefix=f"prefill_{bucket}")
+        if write_record and self.telemetry is not None:
+            self.telemetry.write_record("analysis", {"analysis": report.to_dict()})
+        return report
 
     # -- telemetry ---------------------------------------------------------
 

@@ -36,7 +36,7 @@ def _dispatch_duration(event: str, duration: float, **kwargs: Any) -> None:
 
 def _dispatch_cache_event(event: str, key: Any) -> None:
     for tracker in list(_active_trackers):
-        tracker._on_cache_event(event)
+        tracker._on_cache_event(event, key)
 
 
 def _install_dispatcher() -> None:
@@ -67,6 +67,10 @@ class CompileTracker:
         self._events: dict[str, list] = {}  # name -> [count, total_seconds]
         self.cache_hits = 0
         self.cache_misses = 0
+        # which program keys missed (bounded ring): the analyzer's answer to
+        # "a miss happened — of WHAT?" without re-running under a debugger
+        self.recent_miss_keys: list[str] = []
+        self.cache_build_seconds = 0.0
         self._active = False
 
     def start(self) -> "CompileTracker":
@@ -95,14 +99,23 @@ class CompileTracker:
             entry[0] += 1
             entry[1] += float(duration)
 
-    def _on_cache_event(self, event: str) -> None:
+    def _on_cache_event(self, event: str, key: Any = None) -> None:
         if not self._active:
             return
         with self._lock:
             if event == "hit":
                 self.cache_hits += 1
-            else:
+            elif event == "miss":
                 self.cache_misses += 1
+                self.recent_miss_keys.append(repr(key)[:200])
+                if len(self.recent_miss_keys) > 8:
+                    self.recent_miss_keys.pop(0)
+            elif event == "build":
+                # fired by jit_cache after build() returns: (key, seconds)
+                try:
+                    self.cache_build_seconds += float(key[1])
+                except (TypeError, IndexError):
+                    pass
 
     # -- readout -----------------------------------------------------------
 
@@ -128,5 +141,7 @@ class CompileTracker:
                 "compile_seconds": round(backend[1], 4),
                 "jit_cache_hits": self.cache_hits,
                 "jit_cache_misses": self.cache_misses,
+                "jit_cache_build_seconds": round(self.cache_build_seconds, 4),
+                "recent_miss_keys": list(self.recent_miss_keys),
                 "events": events,
             }

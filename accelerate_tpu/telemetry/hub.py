@@ -81,6 +81,14 @@ class Telemetry:
         self._finished = False
         self._last_flush_step: Optional[int] = None
         self._throughput: dict[str, float] = {}
+        # steady-state recompile attribution (analysis/sanitizer.py): the
+        # fused step notes its abstract signature per step; when a compile
+        # fires after warmup, the diff of the last two signatures names the
+        # leaf that forced the retrace — attached to the compile record
+        self._step_signature: Optional[dict] = None
+        self._prev_step_signature: Optional[dict] = None
+        self._signature_changed = False
+        self._last_step_compile_count: Optional[int] = None
         if self.enabled and self.config.track_compiles:
             self.compiles.start()
 
@@ -140,11 +148,63 @@ class Telemetry:
         self.timer.step(outputs)
         if self.timer.steps % self.config.sample_every == 0:
             self.memory.sample()
+        if self.config.track_compiles:
+            self._observe_compiles()
         if self.config.flush_every and self.timer.steps % self.config.flush_every == 0:
             self.flush(step=self.timer.steps)
 
     def _on_optimizer_step(self) -> None:
         self.optimizer_steps += 1
+
+    def note_step_signature(self, args: Any) -> None:
+        """Record the step's abstract call signature (shapes/dtypes per pytree
+        leaf — no device access). ``compiled_step`` calls this per step; the
+        cost is one host-side tree flatten. When :meth:`step` later observes a
+        steady-state recompile, the last two distinct signatures are diffed
+        with ``analysis.explain_recompile`` and the culprit leaf is named in
+        the compile record."""
+        if not self.enabled:
+            return
+        from ..analysis.sanitizer import signature_of
+
+        signature = signature_of(args)
+        if signature != self._step_signature:
+            self._prev_step_signature = self._step_signature
+            self._step_signature = signature
+            self._signature_changed = True
+
+    def _observe_compiles(self) -> None:
+        """Steady-state recompile detection: compiles at step 1 are warmup;
+        a compile on any later step gets a ``{"kind": "compile"}`` record in
+        telemetry.jsonl carrying the signature diff when one was noted."""
+        count = self.compiles.compile_count
+        last = self._last_step_compile_count
+        signature_changed = self._signature_changed
+        self._last_step_compile_count = count
+        self._signature_changed = False
+        if last is None or count <= last or self.timer.steps <= 1:
+            return
+        payload: dict[str, Any] = {
+            "compile_count": count,
+            "new_compiles": count - last,
+            "compile_seconds": self.compiles.compile_seconds,
+        }
+        # compile_count is process-wide: only blame the step's arguments when
+        # the noted step signature actually changed on THIS step — otherwise
+        # the compile came from elsewhere (an eval/analysis program, a fresh
+        # callable) and a diff of older signatures would misdirect
+        if signature_changed and self._prev_step_signature is not None:
+            from ..analysis.sanitizer import explain_recompile
+
+            payload["explain"] = explain_recompile(
+                self._prev_step_signature, self._step_signature
+            )
+        elif self._step_signature is not None:
+            payload["note"] = (
+                "step signature unchanged at this step — the compile came from "
+                "another program (eval/analysis/serving) or a fresh callable"
+            )
+        self.write_record("compile", payload)
 
     @contextmanager
     def pause(self, category: str):

@@ -13,8 +13,10 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 # Telemetry seam: when set, called as ``hook(event, key)`` with event "hit"
-# (a cached program was served) or "miss" (build() ran — a fresh trace, and
-# almost always a fresh XLA compile). telemetry.CompileTracker installs a
+# (a cached program was served), "miss" (build() is about to run — a fresh
+# trace, and almost always a fresh XLA compile), or "build" (build() returned;
+# key is ``(cache_key, seconds)`` so trackers can attribute trace+build wall
+# time to the program that missed). telemetry.CompileTracker installs a
 # dispatcher here; the hook must never raise into the hot path, so callers
 # fire it through ``_fire_cache_event``.
 cache_event_hook: Optional[Callable[[str, Any], None]] = None
@@ -41,7 +43,11 @@ def dot_keyed_jit(owner: Any, store_attr: str, key, build: Callable, dot_holder:
     entry = store.get(key)
     if entry is None or entry[0] is not dot_fn:
         _fire_cache_event("miss", key)
+        import time
+
+        t0 = time.perf_counter()
         store[key] = (dot_fn, build())
+        _fire_cache_event("build", (key, time.perf_counter() - t0))
     else:
         _fire_cache_event("hit", key)
     return store[key][1]

@@ -979,6 +979,86 @@ def bench_resilience() -> dict:
     return result
 
 
+def bench_analysis() -> dict:
+    """Analyzer-on-the-benchmarks (docs/analysis.md): audit the bert + llama
+    step programs and record analyzer wall time plus the collective
+    inventory, so collective counts/bytes become part of the tracked perf
+    trajectory — a sharding regression (a new all-gather, a collective that
+    doubled in bytes) shows up here as a diffable number before it shows up
+    as a slow step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, ParallelismConfig
+    from accelerate_tpu.models import Bert, Llama
+
+    result: dict = {}
+
+    def summarize(prefix: str, report) -> None:
+        result[f"{prefix}_wall_s"] = report.meta["analysis_seconds"]
+        result[f"{prefix}_findings_error"] = len(report.errors)
+        result[f"{prefix}_findings_warning"] = len(report.warnings)
+        donation = report.inventory.get("donation", {})
+        result[f"{prefix}_donation_declared"] = donation.get("declared", 0)
+        result[f"{prefix}_donation_aliased"] = donation.get("aliased", 0)
+        for kind, stats in sorted(report.inventory.get("collectives", {}).items()):
+            result[f"{prefix}_collective_{kind}_count"] = stats["count"]
+            result[f"{prefix}_collective_{kind}_mib"] = round(stats["bytes"] / (1 << 20), 3)
+
+    # bert step: the primary bench section's exact program (data-parallel)
+    _reset_state()
+    accelerator = Accelerator(mixed_precision="bf16")
+    model = Bert(os.environ.get("BENCH_ANALYSIS_BERT", "bert-base"))
+    accelerator.prepare_model(model)
+    accelerator.prepare_optimizer(optax.adamw(2e-5))
+    batch_size, seq_len = 32, 128
+    rng = np.random.default_rng(0)
+    sharding = accelerator.state.data_sharding()
+    batch = {
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, 30522, (batch_size, seq_len)), jnp.int32), sharding
+        ),
+        "attention_mask": jax.device_put(jnp.ones((batch_size, seq_len), jnp.int32), sharding),
+        "token_type_ids": jax.device_put(jnp.zeros((batch_size, seq_len), jnp.int32), sharding),
+        "labels": jax.device_put(jnp.asarray(rng.integers(0, 2, (batch_size,)), jnp.int32), sharding),
+    }
+    summarize(
+        "analysis_bert",
+        accelerator.analyze(Bert.loss_fn(model), batch, label="bert_step", write_record=False),
+    )
+
+    # llama step: the FSDP section's program — sharded intent, so a large
+    # param resolving to replication would fail the error gate here
+    _reset_state()
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
+        fsdp_plugin=FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=True),
+    )
+    llama = Llama(os.environ.get("BENCH_ANALYSIS_LLAMA", "llama-125m"))
+    accelerator.prepare_model(llama)
+    accelerator.prepare_optimizer(optax.adamw(3e-4))
+
+    def loss_fn(params, batch):
+        logits = llama.apply(params, batch["input_ids"])[:, :-1].astype(jnp.float32)
+        tgt = batch["input_ids"][:, 1:]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (lse - tgt_logit).mean()
+
+    lbatch = {
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, llama.config.vocab_size, (8, 1024)), jnp.int32),
+            accelerator.state.data_sharding(),
+        )
+    }
+    report = accelerator.analyze(loss_fn, lbatch, label="llama_fsdp_step", write_record=False)
+    summarize("analysis_llama", report)
+    result["analysis_llama_errors"] = [str(f) for f in report.errors]
+    return result
+
+
 def _bench_subprocess(which: str, timeout: float = 1500) -> dict:
     """Run a big-model bench section in a FRESH process: the training benches
     fetch losses to the host, and on tunneled TPU transports the first
@@ -1038,6 +1118,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "resilience":
         print(json.dumps(bench_resilience()))
         return
+    if os.environ.get("BENCH_ONLY") == "analysis":
+        print(json.dumps(bench_analysis()))
+        return
 
     device0 = jax.devices()[0]
     on_tpu = device0.platform == "tpu"
@@ -1079,6 +1162,7 @@ def main() -> None:
          ("bigmodel_large_resident_s_per_token",)),
         ("serving", bench_serving, ()),
         ("resilience", bench_resilience, ()),
+        ("analysis", bench_analysis, ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport

@@ -73,6 +73,18 @@ def test_telemetry_example(tmp_path):
     assert records[-1]["goodput"]["restarts"] == 1
 
 
+def test_analysis_example(tmp_path):
+    import json
+
+    out = run_example("by_feature/analysis.py", "--project_dir", str(tmp_path))
+    assert "analysis demo complete" in out
+    assert "donation: 76/76 declared buffers aliased" in out
+    assert "HOST_SYNC" in out and "WARM_RECOMPILE" in out
+    records = [json.loads(l) for l in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    kinds = {r["kind"] for r in records}
+    assert "analysis" in kinds  # the audit report + the sanitizer summary
+
+
 def test_tracking_example(tmp_path):
     import json
 
