@@ -11,10 +11,11 @@ Two modes that compose:
        accelerate-tpu analyze train.py my_pkg/ --strict
 
 2. **Self-check** (``--self-check``): build the repo's own bert-tiny fused
-   step program and a llama-tiny serving decode program and run the full
-   compiled-program audit (donation aliasing, fp64, constants, collective
-   inventory, replication) over both — the same gate
-   ``tests/test_analysis.py`` enforces, runnable anywhere::
+   step program, a llama-tiny serving decode program, and the routed
+   (2-replica fleet) decode path, and run the full compiled-program audit
+   (donation aliasing, fp64, constants, collective inventory, replication)
+   over each — the same gate ``tests/test_analysis.py`` enforces, runnable
+   anywhere::
 
        accelerate-tpu analyze --self-check
 
@@ -86,10 +87,22 @@ def _self_check(compile: bool):
     )
 
     llama = Llama("llama-tiny")
-    engine = ServingEngine(llama, llama.init(jax.random.key(0)), num_slots=2, max_len=32)
+    lparams = llama.init(jax.random.key(0))
+    engine = ServingEngine(llama, lparams, num_slots=2, max_len=32)
     reports.append(
         engine.analyze(compile=compile, write_record=False)
     )
+
+    # the routed decode path: replication must not change the program, so a
+    # 2-replica fleet's per-replica audits must come back exactly as clean
+    # (donation intact on EVERY replica) as the lone engine's above
+    from ..serving import ServingRouter
+
+    router = ServingRouter(
+        engine_factory=lambda: ServingEngine(llama, lparams, num_slots=2, max_len=32),
+        num_replicas=2,
+    )
+    reports.append(router.analyze(compile=compile, write_record=False))
     return reports
 
 

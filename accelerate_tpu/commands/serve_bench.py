@@ -1,13 +1,20 @@
-"""`accelerate-tpu serve-bench` — drive the continuous-batching engine under
-offered load and report serving metrics.
+"""`accelerate-tpu serve-bench` — drive the continuous-batching engine (or a
+routed fleet of engine replicas) under offered load and report serving
+metrics.
 
 The serving analogue of `bench.py`'s training sections: a deterministic
-mixed-length prompt trace replays against :class:`serving.ServingEngine` at
-one or more offered rates (requests/sec; the final sweep point is always
-saturation — everything at once), and each point reports throughput,
+mixed-length prompt trace replays against :class:`serving.ServingEngine` —
+or, with ``--replicas N``, a :class:`serving.ServingRouter` over N replicas
+— at one or more offered rates (requests/sec; the final sweep point is
+always saturation — everything at once), and each point reports throughput,
 TTFT/per-token percentiles, slot occupancy, and compile attribution. Works
 on any backend (the CPU mesh included), so serve sizing can be rehearsed
 before touching a TPU.
+
+``--chaos replica-kill`` arms the replica-death drill: one of the replicas
+is SIGKILLed (router-side, deterministic step) mid-stream at the saturation
+point, and the report adds the failover accounting — every offered request
+must still terminate, goodput retained is printed against the healthy run.
 """
 
 from __future__ import annotations
@@ -33,6 +40,20 @@ def register_subcommand(subparsers):
         default=[],
         help="Offered rates (req/s) to sweep before the saturation point",
     )
+    parser.add_argument(
+        "--replicas", type=int, default=1,
+        help="Engine replicas behind a health-aware router (1 = bare engine)",
+    )
+    parser.add_argument(
+        "--chaos", choices=["replica-kill", "replica-stall", "heartbeat-loss"],
+        default=None,
+        help="Fleet fault to inject mid-stream at the saturation point "
+             "(requires --replicas >= 2)",
+    )
+    parser.add_argument(
+        "--chaos-step", type=int, default=None,
+        help="Fleet step the fault fires at (default: max-new-tokens // 2)",
+    )
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--int8", action="store_true", help="int8 weight-only load path")
@@ -49,7 +70,11 @@ def run(args) -> int:
     import jax.numpy as jnp
 
     from ..models import build_model
-    from ..serving import ServingEngine, make_prompts, run_offered_load
+    from ..serving import ServingEngine, ServingRouter, make_prompts, run_offered_load
+
+    if args.chaos is not None and args.replicas < 2:
+        print(f"--chaos {args.chaos} needs --replicas >= 2 (a 1-replica fleet has no failover)")
+        return 1
 
     model = build_model(args.model)
     params = model.init(jax.random.key(args.seed))
@@ -75,22 +100,67 @@ def run(args) -> int:
 
     def fresh_engine():
         # one model instance across engines: the jit cache lives on it, so
-        # only the FIRST engine compiles — later sweep points measure clean
+        # only the FIRST engine compiles — later sweep points (and every
+        # extra replica) measure clean
         return ServingEngine(
             model, params, num_slots=args.num_slots, max_len=args.max_len,
             eos_token_id=args.eos_token_id, temperature=args.temperature,
         )
 
+    def fresh_target(fault_plan=None):
+        if args.replicas == 1:
+            return fresh_engine()
+        return ServingRouter(
+            engine_factory=fresh_engine, num_replicas=args.replicas,
+            fault_plan=fault_plan,
+        )
+
+    def fleet_fault_plan():
+        from ..resilience import FaultPlan
+
+        step = args.chaos_step if args.chaos_step is not None else args.max_new_tokens // 2
+        kwargs = {
+            "replica-kill": {"replica_kill_step": step, "replica_kill_index": args.replicas - 1},
+            "replica-stall": {"replica_stall_step": step, "replica_stall_index": args.replicas - 1},
+            "heartbeat-loss": {"heartbeat_loss_step": step, "heartbeat_loss_index": args.replicas - 1},
+        }[args.chaos]
+        return FaultPlan(seed=args.seed, **kwargs)
+
     # warmup: one synthetic request per prefill bucket + the decode step —
     # deterministic full coverage, so no sweep point ever straddles a compile
-    warm_engine = fresh_engine()
+    warm_engine = fresh_target()
     warm_engine.warmup()
     warm = warm_engine.metrics()
     points = [
-        run_offered_load(fresh_engine(), prompts, args.max_new_tokens, offered_rps=rate)
+        run_offered_load(fresh_target(), prompts, args.max_new_tokens, offered_rps=rate)
         for rate in args.offered_load
     ]
-    points.append(run_offered_load(fresh_engine(), prompts, args.max_new_tokens, math.inf))
+    points.append(run_offered_load(fresh_target(), prompts, args.max_new_tokens, math.inf))
+
+    drill = None
+    if args.chaos is not None:
+        target = fresh_target(fault_plan=fleet_fault_plan())
+        drill = run_offered_load(target, prompts, args.max_new_tokens, math.inf)
+        healthy = points[-1]
+        drill.update(
+            {
+                "chaos": args.chaos,
+                "replica_deaths": target.replica_deaths,
+                "failovers": target.failovers,
+                # every offered request must reach a terminal state — the
+                # loadgen's completed count IS the accounting check
+                "accounted": drill["requests_completed"],
+                "goodput_retained": (
+                    round(
+                        drill["throughput_tokens_per_sec"]
+                        / healthy["throughput_tokens_per_sec"],
+                        4,
+                    )
+                    if healthy["throughput_tokens_per_sec"]
+                    else None
+                ),
+            }
+        )
 
     payload = {
         "model": args.model,
@@ -98,24 +168,31 @@ def run(args) -> int:
         "max_len": args.max_len,
         "requests": args.requests,
         "max_new_tokens": args.max_new_tokens,
+        "replicas": args.replicas,
         "int8": bool(args.int8),
         # each sweep point's engine carries its own CompileTracker, scoped to
         # its lifetime: the saturation point's count IS the steady-state count
+        # (for a fleet: any replica's tracker sees the process-wide stream, so
+        # one count covers every replica — and it must still be 0)
         "warmup_compile_count": warm["compile_count"],
         "steady_state_compile_count": points[-1]["compile_count"],
         "sweep": points,
     }
+    if drill is not None:
+        payload["chaos_drill"] = drill
     if args.json:
         print(json.dumps(payload))
         return 0
+    fleet = f", {args.replicas} replicas" if args.replicas > 1 else ""
     print(
-        f"serve-bench {args.model}: {args.num_slots} slots × {args.max_len} tokens, "
+        f"serve-bench {args.model}: {args.num_slots} slots × {args.max_len} tokens{fleet}, "
         f"{args.requests} requests, max_new={args.max_new_tokens}"
         + (", int8 weights" if args.int8 else "")
     )
     print(
         f"compiles: {payload['warmup_compile_count']} at warmup, "
-        f"{payload['steady_state_compile_count']} after (steady state must be 0)"
+        f"{payload['steady_state_compile_count']} after (steady state must be 0"
+        + (" — per replica" if args.replicas > 1 else "") + ")"
     )
     header = (
         f"{'offered req/s':>14} | {'tok/s':>9} | {'ttft p50':>9} | {'ttft p99':>9} | "
@@ -130,5 +207,14 @@ def run(args) -> int:
             f"{point.get('ttft_p50_ms', 0):>7.1f}ms | {point.get('ttft_p99_ms', 0):>7.1f}ms | "
             f"{point.get('per_token_p50_ms', 0):>6.1f}ms | {point.get('per_token_p99_ms', 0):>6.1f}ms | "
             f"{point['slot_occupancy']:>9.2f}"
+        )
+    if drill is not None:
+        retained = drill["goodput_retained"]
+        print(
+            f"chaos drill ({drill['chaos']}): {drill['requests_completed']}/"
+            f"{drill['offered_requests']} requests terminated, "
+            f"{drill['replica_deaths']} replica death(s), {drill['failovers']} failover(s), "
+            f"goodput retained "
+            + (f"{retained:.2f}x vs healthy" if retained is not None else "n/a")
         )
     return 0

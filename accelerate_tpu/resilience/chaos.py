@@ -20,7 +20,17 @@ Fault legs:
   (the spot-VM preemption drill; ``CheckpointManager`` must boundary-save);
 - ``serving_burst_step`` / ``serving_burst_size`` — a burst of synthetic
   requests pushed straight into a ``ServingEngine``'s queue (bypassing
-  admission control, so the pressure is real) to force shedding.
+  admission control, so the pressure is real) to force shedding;
+- ``replica_kill_step`` / ``replica_kill_index`` — at the chosen *fleet*
+  step, the :class:`~..serving.router.ServingRouter` treats replica ``index``
+  as SIGKILLed: the engine is unreachable from that instant (its queue and
+  cache are gone), and every in-flight request must be re-homed from the
+  router's own bookkeeping;
+- ``replica_stall_step`` / ``replica_stall_index`` — one replica's step
+  stalls for ``stall_seconds`` (straggler weather at fleet scale);
+- ``heartbeat_loss_step`` / ``heartbeat_loss_index`` — the chosen replica's
+  heartbeat probe goes permanently silent: the process may be alive, but an
+  unreachable replica is operationally dead and the router must fail over.
 
 Activation: pass a plan to ``ResilienceConfig(fault_plan=...)`` /
 ``ServingEngine(fault_plan=...)``, or export ``ACCELERATE_CHAOS_*`` (see
@@ -67,6 +77,14 @@ class FaultPlan:
     sigterm_step: Optional[int] = None
     serving_burst_step: Optional[int] = None
     serving_burst_size: int = 0
+    # fleet faults: indices are ServingRouter fleet-step counts (0-based,
+    # checked at the TOP of the router step, before any replica decodes)
+    replica_kill_step: Optional[int] = None
+    replica_kill_index: int = 0
+    replica_stall_step: Optional[int] = None
+    replica_stall_index: int = 0
+    heartbeat_loss_step: Optional[int] = None
+    heartbeat_loss_index: int = 0
 
     # ledger of injected faults (appended in firing order); ``sink`` is set by
     # the resilience hub so every injection also lands in telemetry.jsonl
@@ -88,6 +106,9 @@ class FaultPlan:
             return None
         sigterm = env.get("ACCELERATE_CHAOS_SIGTERM_STEP")
         burst_step = env.get("ACCELERATE_CHAOS_SERVING_BURST_STEP")
+        kill_step = env.get("ACCELERATE_CHAOS_REPLICA_KILL_STEP")
+        rstall_step = env.get("ACCELERATE_CHAOS_REPLICA_STALL_STEP")
+        hb_step = env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_STEP")
         return cls(
             seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
             nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
@@ -98,6 +119,12 @@ class FaultPlan:
             sigterm_step=int(sigterm) if sigterm else None,
             serving_burst_step=int(burst_step) if burst_step else None,
             serving_burst_size=int(env.get("ACCELERATE_CHAOS_SERVING_BURST_SIZE", "0")),
+            replica_kill_step=int(kill_step) if kill_step else None,
+            replica_kill_index=int(env.get("ACCELERATE_CHAOS_REPLICA_KILL_INDEX", "0")),
+            replica_stall_step=int(rstall_step) if rstall_step else None,
+            replica_stall_index=int(env.get("ACCELERATE_CHAOS_REPLICA_STALL_INDEX", "0")),
+            heartbeat_loss_step=int(hb_step) if hb_step else None,
+            heartbeat_loss_index=int(env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_INDEX", "0")),
         )
 
     @property
@@ -108,6 +135,9 @@ class FaultPlan:
             or self.stall_steps
             or self.sigterm_step is not None
             or self.serving_burst_size
+            or self.replica_kill_step is not None
+            or self.replica_stall_step is not None
+            or self.heartbeat_loss_step is not None
         )
 
     def _record(self, fault: str, **detail) -> None:
@@ -158,6 +188,45 @@ class FaultPlan:
             self._record("serving_burst", step=engine_step, size=self.serving_burst_size)
             return self.serving_burst_size
         return 0
+
+    # -- fleet-side hooks (driven by ServingRouter per fleet step) -----------
+
+    def replica_kill(self, fleet_step: int, valid=None) -> Optional[int]:
+        """Index of the replica to SIGKILL at this fleet step, or None.
+
+        ``valid`` (the router passes its own check: index in range, replica
+        still alive) gates the injection BEFORE it is recorded — the ledger
+        and telemetry must only claim faults that actually fired, or a drill
+        against a mistargeted index looks armed while testing nothing."""
+        if self.replica_kill_step == fleet_step:
+            if valid is not None and not valid(self.replica_kill_index):
+                return None
+            self._record("replica_kill", step=fleet_step, replica=self.replica_kill_index)
+            return self.replica_kill_index
+        return None
+
+    def replica_stall(self, fleet_step: int, valid=None) -> Optional[tuple[int, float]]:
+        """``(replica_index, seconds)`` to stall at this fleet step, or None."""
+        if self.replica_stall_step == fleet_step:
+            if valid is not None and not valid(self.replica_stall_index):
+                return None
+            self._record(
+                "replica_stall", step=fleet_step, replica=self.replica_stall_index,
+                seconds=self.stall_seconds,
+            )
+            return self.replica_stall_index, self.stall_seconds
+        return None
+
+    def heartbeat_loss(self, fleet_step: int, valid=None) -> Optional[int]:
+        """Replica whose heartbeat goes permanently silent at this step."""
+        if self.heartbeat_loss_step == fleet_step:
+            if valid is not None and not valid(self.heartbeat_loss_index):
+                return None
+            self._record(
+                "heartbeat_loss", step=fleet_step, replica=self.heartbeat_loss_index
+            )
+            return self.heartbeat_loss_index
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -110,3 +110,27 @@ class RetryPolicy:
 # commit protocol, the disk-offload weight store, and the data loader's batch
 # fetch all ride unless a caller passes its own policy.
 DEFAULT_IO_RETRY = RetryPolicy()
+
+
+def is_fleet_transient(exception: Exception) -> bool:
+    """Classifier for the serving fleet's weather: a lost replica
+    (:class:`~..serving.fleet.ReplicaLost`) and a saturated queue
+    (:class:`~..serving.scheduler.QueueFull`) are both conditions that a
+    re-home or a backoff rides out — the request is fine, the *placement*
+    failed. Everything else falls through to the I/O classifier, so a
+    genuinely malformed request (``ValueError``: prompt longer than any
+    bucket) fails fast instead of bouncing around the fleet forever."""
+    from ..serving.fleet import ReplicaLost
+    from ..serving.scheduler import QueueFull
+
+    if isinstance(exception, (ReplicaLost, QueueFull)):
+        return True
+    return _default_classify(exception)
+
+
+# Placement retries inside the router: much tighter than disk I/O — a fleet
+# re-offer happens once per router step, so the backoff only paces callers
+# that retry *outside* the step loop (loadgen, blocking clients).
+FLEET_RETRY = RetryPolicy(
+    max_attempts=6, base_delay=0.05, max_delay=2.0, classify=is_fleet_transient
+)

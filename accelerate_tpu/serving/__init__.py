@@ -1,24 +1,36 @@
-"""Continuous-batching inference: slot KV cache, scheduler, serving engine.
+"""Continuous-batching inference: slot KV cache, scheduler, engine, fleet.
 
-The first subsystem on the inference side of the stack (see
-docs/serving.md): one fixed-shape jitted decode step stays hot while
-requests of any prompt length multiplex through preallocated cache slots —
-zero steady-state recompiles, per-step admission, immediate slot reuse on
-EOS. Later serving work (paging, multi-host serve meshes, speculative
-decoding) builds on these pieces.
+The inference side of the stack (see docs/serving.md): one fixed-shape
+jitted decode step stays hot while requests of any prompt length multiplex
+through preallocated cache slots — zero steady-state recompiles, per-step
+admission, immediate slot reuse on EOS. Above the single engine sits the
+fleet layer (``router.py`` / ``fleet.py``): a health-aware
+:class:`ServingRouter` spreads load over N engine replicas behind the same
+``submit/cancel/step/run`` surface, fails requests over when a replica dies,
+and folds the degradation ladder (shed → deadline-expire → quarantine)
+fleet-wide. Later serving work (paging, prefill/decode pools with live KV
+handoff, speculative decoding) builds on these pieces.
 """
 
 from .engine import ServingEngine, ServingResult, StepWatchdog, params_from_streamed
+from .fleet import EngineReplica, HealthPolicy, ReplicaLost, ReplicaState
 from .kv_cache import SlotAllocator, SlotKVCache, bucket_for, kv_cache_bytes, prefill_buckets
 from .loadgen import make_prompts, run_offered_load
+from .router import RoutedRequest, ServingRouter
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
 
 __all__ = [
     "ContinuousBatchingScheduler",
+    "EngineReplica",
+    "HealthPolicy",
     "QueueFull",
+    "ReplicaLost",
+    "ReplicaState",
     "Request",
+    "RoutedRequest",
     "ServingEngine",
     "ServingResult",
+    "ServingRouter",
     "SlotAllocator",
     "SlotKVCache",
     "StepWatchdog",

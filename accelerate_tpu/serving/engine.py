@@ -75,6 +75,29 @@ class ServingResult:
         return np.concatenate([self.prompt, self.generated])
 
 
+def generation_row(
+    prompt, result: ServingResult, max_new_tokens: int, eos_token_id
+) -> np.ndarray:
+    """``generate()``'s output contract for one finished request: a
+    ``[S + max_new_tokens]`` row, EOS-filled past the first EOS (the
+    done-mask shape). Shared by engine and router ``generate_many`` so the
+    two can never drift. A request that did not finish naturally raises —
+    padding a failed/expired/cancelled request would hand the caller a row
+    indistinguishable from a genuine completion."""
+    if result.finish_reason not in ("eos", "length"):
+        raise RuntimeError(
+            f"request {result.request_id} terminated as "
+            f"'{result.finish_reason}', not a completion — no output row"
+        )
+    row = np.concatenate([np.asarray(prompt, np.int32), result.generated])
+    full = np.asarray(prompt).size + max_new_tokens
+    if row.size < full:  # finished on EOS (eos_token_id is set, or the row is full)
+        row = np.concatenate(
+            [row, np.full((full - row.size,), eos_token_id, np.int32)]
+        )
+    return row
+
+
 def params_from_streamed(streamed) -> dict:
     """Reassemble full device-resident params from a ``StreamedModel``.
 
@@ -171,8 +194,12 @@ class ServingEngine:
         fault_plan: Any = None,
         max_probe_failures: int = 16,
         max_request_requeues: int = 2,
+        name: Optional[str] = None,
     ):
         self.model = model
+        # ``name`` tags this engine's telemetry records — a routed fleet sets
+        # it per replica so degradation events are attributable
+        self.name = name
         self.params = params
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
@@ -220,6 +247,7 @@ class ServingEngine:
         self._probe_failures: dict[int, int] = {}
         self._decode_warm = False  # first decode completed (compile behind us)
         self._donation_checked = False  # one consult after the first compile
+        self._draining = False  # drain(): stop admitting, finish active slots
 
     # -- jitted programs (dot-keyed: shared cache with generate()) ----------
 
@@ -369,6 +397,18 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the slot capacity max_len={self.cache.max_len}"
             )
+        if self._draining:
+            self.stats.record_reject()
+            hint = self.retry_after_hint()
+            self._resilience(
+                {"event": "shed", "reason": "draining",
+                 "queue_depth": self.scheduler.waiting, "retry_after_s": hint}
+            )
+            raise QueueFull(
+                "engine is draining — not admitting new requests",
+                queue_depth=self.scheduler.waiting,
+                retry_after_s=hint,
+            )
         try:
             request = self.scheduler.submit(
                 prompt,
@@ -394,8 +434,62 @@ class ServingEngine:
     def cancel(self, request_id: int) -> bool:
         """Client cancellation. Queued or active, the request is retired (and
         an active one's slot freed) at the top of the next ``step()``; returns
-        whether the id was found in flight."""
+        whether the id was found in flight. A ``True`` here is a promise: the
+        request's terminal result will say ``cancelled`` — even when the
+        cancel lands mid-step on a request that would have retired naturally
+        that same step (the retire loop re-checks the flag), so a caller that
+        releases per-request bookkeeping on cancel never sees a second,
+        contradictory terminal result for the same id."""
         return self.scheduler.cancel(request_id)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> tuple[list[dict], list[ServingResult]]:
+        """Stop admitting and hand the waiting queue back for re-homing.
+
+        After this, ``submit()`` sheds (``QueueFull``) and ``step()`` keeps
+        running until the active slots finish — the graceful half of replica
+        retirement. Returns ``(payloads, retired)``: ``payloads`` are the
+        still-queued requests' ``(prompt, params)`` dicts
+        (:attr:`~.scheduler.Request.payload`) for the router to re-submit
+        elsewhere; ``retired`` are results for queued requests that were
+        already cancelled or past deadline — those must terminate *here*, not
+        be resurrected on another engine."""
+        self._draining = True
+        now = time.perf_counter()
+        retired = []
+        for request in self.scheduler.sweep_queue(now):
+            self._record_degraded(request)
+            retired.append(self._result_for(request))
+        drained = self.scheduler.drain_queue()
+        payloads = [request.payload for request in drained]
+        for _ in drained:
+            self.stats.record_rehomed()
+        self._resilience(
+            {"event": "drain", "queued_rehomed": len(payloads),
+             "active": len(self.scheduler.active_slots)}
+        )
+        return payloads, retired
+
+    def resume_admission(self) -> None:
+        """Undo :meth:`drain`: the engine admits again (maintenance ended)."""
+        self._draining = False
+
+    def snapshot_requests(self, include_active: bool = True) -> list[dict]:
+        """Non-destructive payload view of every in-flight request (queued
+        and, by default, active) — what a router re-homes when this replica
+        is lost. Cancelled requests are excluded: re-submitting one would
+        resurrect a request the client already abandoned."""
+        payloads = [r.payload for r in self.scheduler.queue if not r.cancelled]
+        if include_active:
+            payloads += [
+                self.scheduler.slots[slot].payload
+                for slot in self.scheduler.active_slots
+                if not self.scheduler.slots[slot].cancelled
+            ]
+        return payloads
 
     def retry_after_hint(self) -> float:
         """Estimated seconds until a queue position frees: the backlog drains
@@ -478,6 +572,8 @@ class ServingEngine:
         """Queue-pressure burst from the chaos plan: synthetic requests pushed
         straight into the scheduler queue (bypassing admission control — the
         point is to saturate it so real submits shed)."""
+        if self._draining:  # a draining engine admits nothing, chaos included
+            return
         burst = self.chaos.serving_burst(self._steps) if self.chaos is not None else 0
         if not burst:
             return
@@ -605,6 +701,17 @@ class ServingEngine:
                 self._probe_failures[slot] = 0
                 self.stats.record_quarantine()
                 continue
+            if request.cancelled:
+                # the cancel landed DURING this step (a server thread, or a
+                # router failing the replica over) — it must win over natural
+                # retirement, or cancel()'s True is contradicted by a
+                # same-step "length"/"eos" result and whoever released
+                # per-request state on the ack frees it twice
+                self.cache.retire(slot)
+                done = self.scheduler.retire(slot, "cancelled")
+                self._record_degraded(done, slot=slot)
+                finished.append(self._result_for(done))
+                continue
             delivered += 1
             token = int(tokens[slot])
             request.generated.append(token)
@@ -617,6 +724,15 @@ class ServingEngine:
                 self.cache.retire(slot)
                 done = self.scheduler.retire(slot, "eos" if hit_eos else "length")
                 self.stats.record_finish(done.latency_s)
+                finished.append(self._result_for(done))
+            elif request.past_deadline(now):
+                # the deadline passed during the decode: retiring here (with
+                # the partial output, this step's token included) saves the
+                # doomed request one more decode step vs waiting for the
+                # top-of-next-step sweep
+                self.cache.retire(slot)
+                done = self.scheduler.retire(slot, "expired")
+                self._record_degraded(done, slot=slot)
                 finished.append(self._result_for(done))
             else:
                 self._pending[slot] = token
@@ -658,17 +774,10 @@ class ServingEngine:
         temperature 0, whatever mix of lengths rides in."""
         ids = [self.submit(p, max_new_tokens) for p in prompts]
         results = self.run()
-        out = []
-        for prompt, rid in zip(prompts, ids):
-            r = results[rid]
-            row = np.concatenate([np.asarray(prompt, np.int32), r.generated])
-            full = np.asarray(prompt).size + max_new_tokens
-            if row.size < full:  # finished on EOS: pad like generate()'s done-mask
-                row = np.concatenate(
-                    [row, np.full((full - row.size,), self.eos_token_id, np.int32)]
-                )
-            out.append(row)
-        return out
+        return [
+            generation_row(p, results[rid], max_new_tokens, self.eos_token_id)
+            for p, rid in zip(prompts, ids)
+        ]
 
     # -- program analysis (analysis/: docs/analysis.md) --------------------
 
@@ -801,6 +910,8 @@ class ServingEngine:
         """One ``{"kind": "resilience"}`` degradation record (shed, expiry,
         cancellation, quarantine, watchdog) — no-op without a hub."""
         if self.telemetry is not None:
+            if self.name is not None:
+                payload = {"engine": self.name, **payload}
             self.telemetry.write_record("resilience", payload)
 
     # -- alternate loaders -------------------------------------------------

@@ -1,21 +1,34 @@
 """Offered-load generation for serving benchmarks.
 
-Replays a request trace against a :class:`~.engine.ServingEngine` at a fixed
-offered rate (requests/second, ``inf`` = all at once) with uniform arrival
-spacing, stepping the engine between arrivals. Shared by ``bench.py``'s
-``serving_`` section and the ``accelerate-tpu serve-bench`` CLI so the two
-can never measure differently.
+Replays a request trace against a :class:`~.engine.ServingEngine` (or a
+:class:`~.router.ServingRouter` — same surface) at a fixed offered rate
+(requests/second, ``inf`` = all at once) with uniform arrival spacing,
+stepping the engine between arrivals. Shared by ``bench.py``'s ``serving_``
+section and the ``accelerate-tpu serve-bench`` CLI so the two can never
+measure differently.
+
+A shed arrival (:class:`~.scheduler.QueueFull`) is a *well-behaved client*:
+it backs off by the engine's own ``retry_after_s`` hint — jittered, so a
+thousand clients shed in the same instant don't re-synchronize into the
+next shed wave (the same argument as
+:class:`~..resilience.retry.RetryPolicy`'s jitter) — and re-offers the
+request then, backdated to its intended arrival so the queue wait lands in
+TTFT where it belongs. Sheds and retries are counted separately, which
+keeps the offered-load accounting exact: every prompt is offered once plus
+one retry per shed, so at drain time ``sheds == retries`` and
+``completed == offered`` unless something was genuinely lost.
 """
 
 from __future__ import annotations
 
+import heapq
 import math
 import time
 from typing import Optional, Sequence
 
 import numpy as np
 
-from .engine import ServingEngine
+from .scheduler import QueueFull
 
 
 def make_prompts(
@@ -28,35 +41,61 @@ def make_prompts(
 
 
 def run_offered_load(
-    engine: ServingEngine,
+    engine,
     prompts: Sequence[np.ndarray],
     max_new_tokens: int,
     offered_rps: float = math.inf,
+    backoff_jitter: float = 0.25,
+    min_backoff_s: float = 0.005,
+    seed: int = 0,
 ) -> dict:
     """Submit ``prompts`` at ``offered_rps`` and drive the engine dry.
 
-    Returns the engine's :meth:`~.engine.ServingEngine.metrics` snapshot plus
-    the offered rate and completed-request count. A full queue defers the
-    arrival (re-checked after the next decode step) rather than dropping it,
-    and the submit is backdated to the INTENDED arrival time — the latency
-    cost of the backlog shows up in TTFT, which is the honest place for it.
+    Returns the engine's ``metrics()`` snapshot plus the offered rate,
+    completed-request count, and the loadgen's own shed/retry ledger. A
+    ``QueueFull`` arrival is re-offered after a jittered backoff of the
+    exception's ``retry_after_s`` hint (never immediately — hammering a full
+    queue just measures the shed path), and the eventual submit is backdated
+    to the INTENDED arrival time so backlog wait shows up in TTFT, which is
+    the honest place for it.
     """
     arrivals = [0.0 if math.isinf(offered_rps) else i / offered_rps for i in range(len(prompts))]
+    rng = np.random.default_rng(seed)
+    # (offer_time, index, attempt): a heap, because backoffs reorder arrivals
+    ready: list[tuple[float, int, int]] = [(at, i, 0) for i, at in enumerate(arrivals)]
+    heapq.heapify(ready)
     t0 = time.perf_counter()
-    next_up = 0
     completed = 0
-    while next_up < len(prompts) or engine.busy:
+    sheds = 0  # QueueFull events absorbed by backoff
+    retries = 0  # re-offers (each shed schedules exactly one)
+    while ready or engine.busy:
         now = time.perf_counter() - t0
-        while next_up < len(prompts) and now >= arrivals[next_up] and engine.queue_available:
-            engine.submit(
-                prompts[next_up], max_new_tokens, submitted_at=t0 + arrivals[next_up]
-            )
-            next_up += 1
+        while ready and ready[0][0] <= now:
+            _, idx, attempt = heapq.heappop(ready)
+            if attempt:
+                retries += 1
+            try:
+                engine.submit(
+                    prompts[idx], max_new_tokens, submitted_at=t0 + arrivals[idx]
+                )
+            except QueueFull as e:
+                sheds += 1
+                hint = e.retry_after_s if e.retry_after_s else min_backoff_s
+                delay = max(hint, min_backoff_s) * (
+                    1.0 + backoff_jitter * (2.0 * float(rng.random()) - 1.0)
+                )
+                heapq.heappush(ready, (now + delay, idx, attempt + 1))
         if engine.busy:
             completed += len(engine.step())
-        elif next_up < len(prompts):
-            time.sleep(min(max(arrivals[next_up] - now, 0.0), 0.05))
+        elif ready:
+            time.sleep(min(max(ready[0][0] - now, 0.0), 0.05))
     out = engine.metrics()
     out["offered_rps"] = None if math.isinf(offered_rps) else offered_rps
+    out["offered_requests"] = len(prompts)
     out["requests_completed"] = completed
+    out["loadgen_sheds"] = sheds
+    out["loadgen_retries"] = retries
     return out
+
+
+__all__ = ["make_prompts", "run_offered_load"]

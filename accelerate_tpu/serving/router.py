@@ -1,0 +1,599 @@
+"""Health-aware router over N serving-engine replicas.
+
+One :class:`~.engine.ServingEngine` is one model replica; a fleet needs a
+layer that spreads load across many and survives losing some. The
+:class:`ServingRouter` fronts N engines behind the *same*
+``submit / cancel / step / run / generate_many`` surface the single engine
+exposes, so callers (loadgen, serve-bench, user server loops) cannot tell
+one replica from eight — until one dies, which is the point:
+
+- **placement** is load-aware, not round-robin: each submit goes to the
+  placeable replica (HEALTHY first, then DEGRADED) with the lowest live
+  load score — queue depth plus occupied slots from the replica's own
+  ``ServingStats`` books, the same signal ``retry_after_hint`` prices;
+- **failover** is transparent: every in-flight request is mirrored in the
+  router's own bookkeeping (id → payload), so when a replica dies — step
+  exception, chaos SIGKILL, heartbeat silence — its requests re-submit to a
+  survivor from the *router's* copy, never from the dead engine's memory
+  (SIGKILL semantics: that memory is gone). Recovery re-prefills from the
+  prompt — correct by construction, since at temperature 0 the regenerated
+  tokens are bit-identical and at temperature > 0 no token was ever
+  delivered twice. :meth:`_kv_handoff` is the seam where a future
+  arXiv:2112.01075-style live-KV relayout slots in;
+- **backpressure** composes: overload on one replica drains to the others
+  before ``QueueFull`` ever reaches the caller; only when every placeable
+  replica is full does the router shed, quoting the *minimum*
+  ``retry_after_s`` across the fleet (the soonest any replica frees);
+- **degradation** is fleet-wide: the PR-4 ladder (shed → deadline-expire →
+  quarantine) keeps running per engine, and the health state machine
+  (:mod:`~.fleet`) folds those per-replica events into placement decisions.
+
+Every replica runs the same fixed-shape programs as a lone engine —
+replication never costs a recompile (the GSPMD argument, arXiv:2105.04663:
+programs are shape-polymorphic in *nothing*, so N copies share one compile
+via the model's jit cache), and ``serving_steady_state_compile_count == 0``
+holds per replica in the routed configuration.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry.serving import fleet_rollup
+from .engine import ServingEngine, ServingResult, generation_row
+from .fleet import EngineReplica, HealthPolicy, ReplicaLost, ReplicaState
+from .scheduler import QueueFull
+
+# Router request ids live far above any engine-internal id (engine schedulers
+# count from 0 for their own synthetic requests — warmup probes, chaos
+# bursts), so a routed id can never collide with one and the router can trust
+# `result.request_id in self._inflight` as "this is mine".
+_ROUTER_ID_BASE = 1 << 40
+
+
+@dataclass
+class RoutedRequest:
+    """The router's own copy of one in-flight request — the failover source
+    of truth. Deliberately payload-only (no generated tokens): re-homing
+    restarts from the prompt, so this record is sufficient whether the
+    source replica drained gracefully or vanished mid-decode."""
+
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    deadline_s: Optional[float]
+    submitted_at: float
+    replica: Optional[int] = None  # index hosting it; None = router-pending
+    last_replica: Optional[int] = None  # previous host (KV-handoff source)
+    failovers: int = 0
+    cancelled: bool = False
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
+
+class ServingRouter:
+    """N engine replicas behind the single-engine serving surface."""
+
+    def __init__(
+        self,
+        engines: Optional[Sequence[ServingEngine]] = None,
+        *,
+        engine_factory: Optional[Any] = None,
+        num_replicas: Optional[int] = None,
+        health: Optional[HealthPolicy] = None,
+        telemetry: Any = None,
+        fault_plan: Any = None,
+        max_failovers: int = 2,
+    ):
+        if engines is None:
+            if engine_factory is None or num_replicas is None:
+                raise ValueError(
+                    "pass engines=, or engine_factory= with num_replicas="
+                )
+            engines = [engine_factory() for _ in range(num_replicas)]
+        elif not engines:
+            raise ValueError("a router needs at least one replica")
+        self.engine_factory = engine_factory
+        self.telemetry = telemetry
+        if fault_plan is None:
+            from ..resilience import chaos as _chaos_mod
+
+            fault_plan = _chaos_mod.active_plan()
+        self.chaos = fault_plan
+        self.max_failovers = max_failovers
+        self.replicas = []
+        for i, engine in enumerate(engines):
+            if engine.name is None:
+                engine.name = f"replica{i}"
+            if engine.telemetry is None and telemetry is not None:
+                engine.telemetry = telemetry
+            self.replicas.append(
+                EngineReplica(i, engine, policy=health, on_transition=self._on_transition)
+            )
+        self._ids = itertools.count(_ROUTER_ID_BASE)
+        self._inflight: dict[int, RoutedRequest] = {}
+        self._pending: list[RoutedRequest] = []  # awaiting (re-)placement
+        self._retired: list[ServingResult] = []  # terminal results made HERE
+        self._drain_moved: dict[int, int] = {}  # re-home counts per drain
+        self._steps = 0
+        # fleet counters (the rollup adds per-engine sums on top)
+        self.router_sheds = 0
+        self.failovers = 0
+        self.failed_failovers = 0
+        self.rehomed = 0
+        self.replica_deaths = 0
+        self.placements = [0] * len(self.replicas)
+
+    # -- the single-engine surface ------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        submitted_at: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Place one request on the least-loaded placeable replica; returns
+        the (fleet-unique) request id. Raises ``ValueError`` for requests no
+        replica can ever serve, :class:`ReplicaLost` when the whole fleet is
+        down, and :class:`QueueFull` — with the fleet-minimum
+        ``retry_after_s`` — only when *every* placeable replica is full."""
+        rr = RoutedRequest(
+            id=next(self._ids),
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s,
+            submitted_at=submitted_at if submitted_at is not None else time.perf_counter(),
+        )
+        candidates = self._placement_order()
+        if not candidates:
+            alive = [r for r in self.replicas if r.alive]
+            if not alive:
+                raise ReplicaLost("no live replicas — the fleet is down")
+            # same shed as the all-full branch below — counted, recorded,
+            # and priced the same way (a draining replica still frees queue
+            # positions, so its hint is the honest wait estimate)
+            self.router_sheds += 1
+            hint = min(r.engine.retry_after_hint() for r in alive)
+            depth = sum(r.engine.scheduler.waiting for r in alive)
+            self._fleet_record(
+                {"event": "shed", "reason": "no_placeable", "queue_depth": depth,
+                 "retry_after_s": hint}
+            )
+            raise QueueFull(
+                "no placeable replicas (all draining/recovering)",
+                queue_depth=depth,
+                retry_after_s=hint,
+            )
+        for replica in candidates:
+            if not replica.engine.queue_available:
+                continue
+            # ValueError (prompt the fleet can never serve) propagates —
+            # every replica shares one shape config, so the first verdict
+            # is the fleet's verdict
+            replica.engine.submit(
+                rr.prompt,
+                rr.max_new_tokens,
+                request_id=rr.id,
+                submitted_at=rr.submitted_at,
+                deadline_s=rr.deadline_s,
+            )
+            rr.replica = replica.index
+            replica.touch()  # placement resets the idle heartbeat clock
+            self.placements[replica.index] += 1
+            self._inflight[rr.id] = rr
+            return rr.id
+        # every placeable replica is full: the router-level shed, priced at
+        # the soonest any replica expects to free a queue position
+        self.router_sheds += 1
+        hint = min(r.engine.retry_after_hint() for r in candidates)
+        depth = sum(r.engine.scheduler.waiting for r in candidates)
+        self._fleet_record(
+            {"event": "shed", "queue_depth": depth, "retry_after_s": hint}
+        )
+        raise QueueFull(
+            f"all {len(candidates)} placeable replicas are full — retry in ~{hint:.3f}s",
+            queue_depth=depth,
+            retry_after_s=hint,
+        )
+
+    def cancel(self, request_id: int) -> bool:
+        """Fleet-wide cancellation: wherever the request lives — a replica's
+        queue or slots, or the router's own pending buffer — it terminates
+        as ``cancelled``. Same promise as the engine's: a ``True`` is never
+        contradicted by a different terminal reason."""
+        rr = self._inflight.get(request_id)
+        if rr is None:
+            return False
+        # the router's own copy is marked FIRST: if the hosting replica dies
+        # after the ack but before retiring the request, the re-home path
+        # must see the cancellation — not resurrect the request on a
+        # survivor and contradict this True with a "length" result
+        rr.cancelled = True
+        if rr.replica is None:
+            return True
+        replica = self.replicas[rr.replica]
+        if replica.alive and replica.engine.cancel(request_id):
+            return True
+        # the hosting replica died between bookkeeping updates: retire the
+        # router's copy through the pending sweep (which emits the
+        # "cancelled" terminal result next step)
+        rr.replica = None
+        self._pending.append(rr)
+        return True
+
+    def step(self) -> list[ServingResult]:
+        """One fleet iteration: inject chaos, re-offer pending (failed-over)
+        requests, step every live replica, fold their health observations,
+        sweep heartbeats, and finish drains. Returns every request that
+        reached a terminal state this step, whichever replica (or the router
+        itself) retired it."""
+        stall = self._inject_chaos()
+        # heartbeat sweep BEFORE stepping: an unreachable replica must not
+        # get one more decode out of the router after its probe went silent
+        for replica in self.replicas:
+            if replica.alive and not replica.heartbeat():
+                self._on_replica_death(replica, "heartbeat lost")
+        results: list[ServingResult] = []
+        if self._retired:
+            results.extend(self._retired)
+            self._retired.clear()
+        self._offer_pending(results)
+        for replica in self.replicas:
+            engine = replica.engine
+            if not replica.alive or not (engine.busy or engine.cache.quarantined):
+                continue
+            if stall is not None and replica.index == stall[0]:
+                # the straggler drill: the stall rides immediately before
+                # THIS replica's decode (every other replica steps at full
+                # speed this iteration, and the target still heartbeats —
+                # it makes progress right after, just late)
+                time.sleep(stall[1])
+            try:
+                step_results = engine.step()
+            except Exception as error:  # noqa: BLE001 - any step failure is a death
+                self._on_replica_death(replica, f"step raised {type(error).__name__}: {error}")
+                continue
+            replica.observe_step()
+            for result in step_results:
+                self._inflight.pop(result.request_id, None)
+                results.append(result)
+        for replica in self.replicas:
+            if replica.state is ReplicaState.DRAINING and not replica.engine.busy:
+                replica.mark_dead("drained")
+                self._fleet_record({"event": "drained", "replica": replica.index})
+        self._steps += 1
+        return results
+
+    @property
+    def busy(self) -> bool:
+        return bool(
+            self._pending
+            or self._retired
+            or any(r.alive and r.engine.busy for r in self.replicas)
+        )
+
+    def run(self) -> dict[int, ServingResult]:
+        """Drive ``step()`` until the whole fleet drains; results by id."""
+        results: dict[int, ServingResult] = {}
+        while self.busy:
+            for result in self.step():
+                results[result.request_id] = result
+        return results
+
+    def generate_many(
+        self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32
+    ) -> list[np.ndarray]:
+        """Blocking batch API with the engine's exact output contract — at
+        temperature 0 a routed fleet is bit-identical to one engine, whatever
+        the placement happened to be. A request the fleet could not complete
+        (failover budget exhausted, every replica lost) raises rather than
+        returning a fabricated row."""
+        eos = self.replicas[0].engine.eos_token_id
+        ids = [self.submit(p, max_new_tokens) for p in prompts]
+        results = self.run()
+        return [
+            generation_row(p, results[rid], max_new_tokens, eos)
+            for p, rid in zip(prompts, ids)
+        ]
+
+    def warmup(self) -> None:
+        """Compile every program on every replica (cache-shared: replicas of
+        one model compile once and hit for the rest)."""
+        for replica in self.replicas:
+            if replica.alive:
+                replica.engine.warmup()
+
+    # -- placement -----------------------------------------------------------
+
+    def _placement_order(self) -> list[EngineReplica]:
+        """Placeable replicas, healthiest-then-least-loaded first."""
+        return sorted(
+            (r for r in self.replicas if r.placeable),
+            key=lambda r: (r.state is not ReplicaState.HEALTHY, r.load_score(), r.index),
+        )
+
+    def _offer_pending(self, results: list[ServingResult]) -> None:
+        """Re-offer router-pending (failed-over / drained-out) requests.
+        Placement failures are classified like any fleet weather: transient
+        (queue full) keeps the request pending for the next step, fatal
+        (malformed) terminates it — a bad request must not bounce around the
+        fleet forever."""
+        from ..resilience.retry import is_fleet_transient
+
+        if not self._pending:
+            return
+        still_pending: list[RoutedRequest] = []
+        now = time.perf_counter()
+        for rr in self._pending:
+            if rr.cancelled:
+                self._inflight.pop(rr.id, None)
+                results.append(self._terminal(rr, "cancelled", now))
+                continue
+            if rr.deadline_at is not None and now >= rr.deadline_at:
+                self._inflight.pop(rr.id, None)
+                results.append(self._terminal(rr, "expired", now))
+                continue
+            settled = False  # placed on a replica, or terminally failed
+            src = (
+                self.replicas[rr.last_replica]
+                if rr.last_replica is not None
+                else None
+            )
+            for replica in self._placement_order():
+                if not replica.engine.queue_available:
+                    continue
+                # the KV-handoff seam: when the previous host is still
+                # readable (graceful drain, not SIGKILL) a future relayout
+                # path moves the live cache slice instead of re-prefilling.
+                # A True would mean the KV moved — and this call site must
+                # then change how it schedules the request, so fail loudly
+                # rather than hand off AND re-prefill (delivering twice).
+                if src is not None and src.alive and self._kv_handoff(src, replica, rr):
+                    raise NotImplementedError(
+                        "_kv_handoff returned True but the re-home path only "
+                        "implements re-prefill — a live-KV relayout must also "
+                        "take over scheduling the request on the destination"
+                    )
+                try:
+                    replica.engine.submit(
+                        rr.prompt,
+                        rr.max_new_tokens,
+                        request_id=rr.id,
+                        submitted_at=rr.submitted_at,
+                        deadline_s=rr.deadline_s,
+                    )
+                except Exception as error:  # noqa: BLE001 - classifier decides
+                    if is_fleet_transient(error):
+                        continue
+                    self._inflight.pop(rr.id, None)
+                    results.append(self._terminal(rr, "failed", now))
+                    settled = True
+                    break
+                rr.replica = replica.index
+                replica.touch()  # placement resets the idle heartbeat clock
+                self.placements[replica.index] += 1
+                self.rehomed += 1
+                self._fleet_record(
+                    {"event": "rehome", "request_id": rr.id, "replica": replica.index,
+                     "failovers": rr.failovers}
+                )
+                settled = True
+                break
+            if not settled:
+                if not any(r.alive for r in self.replicas):
+                    # nobody left to ever take it: terminate, don't strand
+                    self._inflight.pop(rr.id, None)
+                    results.append(self._terminal(rr, "failed", now))
+                else:
+                    still_pending.append(rr)
+        self._pending = still_pending
+
+    # -- failure handling ----------------------------------------------------
+
+    def _inject_chaos(self) -> Optional[tuple[int, float]]:
+        """Fire this fleet step's chaos. Returns the (replica, seconds)
+        stall, if any — applied in the stepping loop so only the TARGET
+        replica's decode is late, not the whole fleet's."""
+        if self.chaos is None:
+            return None
+        # validity gates the plan's own ledger: a mistargeted fault (index
+        # out of range, replica already dead) must not be recorded as fired
+        alive = lambda i: 0 <= i < len(self.replicas) and self.replicas[i].alive
+        in_fleet = lambda i: 0 <= i < len(self.replicas)
+        stall = self.chaos.replica_stall(self._steps, valid=alive)
+        lost = self.chaos.heartbeat_loss(self._steps, valid=in_fleet)
+        if lost is not None:
+            self.replicas[lost].heartbeat_lost = True
+        kill = self.chaos.replica_kill(self._steps, valid=alive)
+        if kill is not None:
+            self._on_replica_death(self.replicas[kill], "chaos replica-kill")
+        return stall
+
+    def _on_replica_death(self, replica: EngineReplica, reason: str) -> None:
+        """A replica is gone (SIGKILL semantics). Re-home every request the
+        router placed there from the router's OWN bookkeeping — the dead
+        engine's queue and KV cache no longer exist, so re-prefill from the
+        prompt is the only correct recovery (and the capped-failover budget
+        keeps a poison request from killing the whole fleet one replica at
+        a time)."""
+        replica.mark_dead(reason)
+        self.replica_deaths += 1
+        orphans = [rr for rr in self._inflight.values() if rr.replica == replica.index]
+        self._fleet_record(
+            {"event": "replica_death", "replica": replica.index, "reason": reason,
+             "orphaned": len(orphans)}
+        )
+        now = time.perf_counter()
+        for rr in orphans:
+            rr.last_replica, rr.replica = rr.replica, None
+            if rr.cancelled:
+                # the client already gave up on it: terminate as cancelled
+                # instead of spending a failover on a request nobody wants
+                self._inflight.pop(rr.id, None)
+                self._retired.append(self._terminal(rr, "cancelled", now))
+                continue
+            rr.failovers += 1
+            if rr.failovers > self.max_failovers:
+                self.failed_failovers += 1
+                self._inflight.pop(rr.id, None)
+                self._retired.append(self._terminal(rr, "failed", now))
+            else:
+                self.failovers += 1
+                self._pending.append(rr)
+
+    def _kv_handoff(self, src: EngineReplica, dst: EngineReplica, rr: RoutedRequest) -> bool:
+        """Seam for live-KV migration between replicas. A request's cache
+        slice is an array-redistribution problem (arXiv:2112.01075 — relayout
+        through portable collectives without materializing the full buffer);
+        until that lands, this returns False and failover re-prefills from
+        the prompt, which is correct by construction. The signature is the
+        contract: src may already be unreachable for anything but its device
+        buffers, and a False here must always leave re-prefill as the path."""
+        return False
+
+    # -- lifecycle operations ------------------------------------------------
+
+    def drain_replica(self, index: int, reason: str = "operator drain") -> int:
+        """Gracefully retire a replica: stop placing, re-home its queue, let
+        active slots finish. Returns how many queued requests were re-homed.
+        The replica transitions DRAINING → DEAD("drained") once empty."""
+        replica = self.replicas[index]
+        replica.start_drain(reason)  # → _on_transition → _rehome_drained
+        moved = self._drain_moved.pop(index, 0)
+        # an already-idle replica completes its drain right here — step()'s
+        # completion sweep only runs when the fleet has work to step
+        if not replica.engine.busy:
+            replica.mark_dead("drained")
+            self._fleet_record({"event": "drained", "replica": replica.index})
+        return moved
+
+    def _rehome_drained(self, replica: EngineReplica, reason: str) -> int:
+        """Drain a DRAINING replica's engine and re-home its queue. Runs on
+        EVERY entry into DRAINING — operator `drain_replica` or the health
+        machine escalating a sick replica — so the documented semantics
+        ("queue re-homed, active slots finish") hold whichever path got
+        there; without this the automatic path would keep feeding queued
+        requests to the replica it just judged too sick to place on."""
+        payloads, retired = replica.engine.drain()
+        for result in retired:
+            self._inflight.pop(result.request_id, None)
+            self._retired.append(result)
+        moved = 0
+        for payload in payloads:
+            rr = self._inflight.get(payload["request_id"])
+            if rr is None:
+                continue  # an engine-internal request; not the router's to re-home
+            rr.last_replica, rr.replica = rr.replica, None
+            self._pending.append(rr)
+            moved += 1
+        self._fleet_record(
+            {"event": "drain", "replica": replica.index, "rehomed": moved,
+             "reason": reason}
+        )
+        return moved
+
+    def revive(self, index: int, warmup: bool = False) -> None:
+        """Bring a DEAD replica back with a fresh engine (new process in a
+        real fleet — requires ``engine_factory``). The replica re-enters
+        placement only after the recovery completes."""
+        if self.engine_factory is None:
+            raise ValueError("revive() needs an engine_factory")
+        replica = self.replicas[index]
+        engine = self.engine_factory()
+        if engine.name is None:
+            engine.name = f"replica{index}"
+        if engine.telemetry is None and self.telemetry is not None:
+            engine.telemetry = self.telemetry
+        replica.begin_recovery(engine)
+        if warmup:
+            engine.warmup()
+        replica.complete_recovery()
+        self._fleet_record({"event": "revive", "replica": index})
+
+    # -- observability -------------------------------------------------------
+
+    def _on_transition(self, replica: EngineReplica, state: ReplicaState, reason: str) -> None:
+        self._fleet_record(
+            {"event": "health", "replica": replica.index, "state": state.value,
+             "reason": reason}
+        )
+        if state is ReplicaState.DRAINING:
+            self._drain_moved[replica.index] = self._rehome_drained(replica, reason)
+
+    def _terminal(self, rr: RoutedRequest, reason: str, now: float) -> ServingResult:
+        return ServingResult(
+            request_id=rr.id,
+            prompt=rr.prompt,
+            generated=np.zeros((0,), np.int32),
+            finish_reason=reason,
+            ttft_s=None,
+            latency_s=now - rr.submitted_at,
+        )
+
+    def _fleet_record(self, payload: dict) -> None:
+        if self.telemetry is not None:
+            self.telemetry.write_record("fleet", {"fleet_step": self._steps, **payload})
+
+    def metrics(self) -> dict:
+        """Fleet-aggregated serving metrics plus router-level counters and
+        the per-replica health summaries."""
+        out = fleet_rollup([r.engine.stats for r in self.replicas])
+        # every engine's CompileTracker observes the PROCESS-wide compile
+        # stream (jax.monitoring has no per-engine scoping), so replica
+        # counts are views of one stream — max, not sum, is the fleet count
+        out["compile_count"] = max(r.engine.compiles.compile_count for r in self.replicas)
+        out["fleet_steps"] = self._steps
+        out["router_sheds"] = self.router_sheds
+        out["failovers"] = self.failovers
+        out["failed_failovers"] = self.failed_failovers
+        out["rehomed"] = self.rehomed
+        out["replica_deaths"] = self.replica_deaths
+        out["pending_depth"] = len(self._pending)
+        out["placements"] = list(self.placements)
+        out["replica_health"] = [r.summary() for r in self.replicas]
+        return out
+
+    def flush_telemetry(self) -> Optional[dict]:
+        """One ``{"kind": "fleet"}`` record with the aggregated metrics."""
+        if self.telemetry is None:
+            return None
+        return self.telemetry.write_record("fleet", {"fleet": self.metrics()})
+
+    def analyze(self, compile: bool = True, write_record: bool = True, **audit_kwargs):
+        """Audit every live replica's decode program — the routed decode
+        path. Replication must never change the program: each replica's
+        audit must come back as clean (donation intact) as a lone engine's."""
+        from ..analysis import AnalysisReport
+
+        report = AnalysisReport(meta={"label": "serving_fleet_decode"})
+        audited = 0
+        for replica in self.replicas:
+            if not replica.alive:
+                continue
+            sub = replica.engine.analyze(
+                compile=compile, include_prefill=False, write_record=False, **audit_kwargs
+            )
+            for finding in sub.findings:
+                finding.path = (
+                    f"replica_{replica.index}:{finding.path}"
+                    if finding.path
+                    else f"replica_{replica.index}"
+                )
+            report.merge(sub, prefix=f"replica_{replica.index}")
+            audited += 1
+        if not audited:
+            raise ReplicaLost("no live replicas to analyze")
+        report.meta["replicas_audited"] = audited
+        if write_record and self.telemetry is not None:
+            self.telemetry.write_record("analysis", {"analysis": report.to_dict()})
+        return report

@@ -93,6 +93,22 @@ class Request:
             return None
         return self.finished_at - self.submitted_at
 
+    @property
+    def payload(self) -> dict:
+        """The re-submittable ``(prompt, params)`` view of this request — what
+        a router needs to re-home it onto another engine. Generated tokens are
+        deliberately absent: failover restarts from the prompt (re-prefill),
+        so the payload is correct whether or not the source engine's cache
+        still exists."""
+        return {
+            "prompt": self.prompt,
+            "max_new_tokens": self.max_new_tokens,
+            "request_id": self.id,
+            "deadline_s": self.deadline_s,
+            "submitted_at": self.submitted_at,
+            "requeues": self.requeues,
+        }
+
 
 class ContinuousBatchingScheduler:
     """FIFO queue in front of ``num_slots`` decode slots."""
@@ -180,6 +196,15 @@ class ContinuousBatchingScheduler:
             request.admitted_at = time.perf_counter()
             self.slots[slot] = request
             yield slot, request
+
+    def drain_queue(self) -> list[Request]:
+        """Remove and return every waiting request (drain: the caller re-homes
+        them elsewhere). Cancelled/expired requests should be swept *before*
+        draining — re-homing a request the client already gave up on would
+        resurrect it on another engine."""
+        drained = list(self.queue)
+        self.queue.clear()
+        return drained
 
     def sweep_queue(self, now: float) -> list[Request]:
         """Remove cancelled / past-deadline requests from the waiting queue

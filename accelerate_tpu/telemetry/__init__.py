@@ -12,7 +12,7 @@ from .goodput import GoodputTracker
 from .hub import Telemetry, TelemetryConfig
 from .memory import MemoryMonitor
 from .profiler import ProfileWindow
-from .serving import ServingStats
+from .serving import ServingStats, fleet_rollup
 from .step_timer import StepTimer, drain_local_devices
 
 __all__ = [
@@ -22,6 +22,7 @@ __all__ = [
     "PEAK_BF16_FLOPS",
     "ProfileWindow",
     "ServingStats",
+    "fleet_rollup",
     "StepTimer",
     "Telemetry",
     "TelemetryConfig",

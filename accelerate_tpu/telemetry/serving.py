@@ -54,6 +54,7 @@ class ServingStats:
         self.requests_cancelled = 0
         self.requests_requeued = 0
         self.requests_failed = 0
+        self.requests_rehomed = 0  # drained out of this engine for another replica
         self.slot_quarantines = 0
         self.slot_quarantine_releases = 0
         self.watchdog_trips = 0
@@ -77,6 +78,9 @@ class ServingStats:
 
     def record_failed(self) -> None:
         self.requests_failed += 1
+
+    def record_rehomed(self) -> None:
+        self.requests_rehomed += 1
 
     def record_quarantine(self) -> None:
         self.slot_quarantines += 1
@@ -144,6 +148,7 @@ class ServingStats:
             "requests_cancelled": self.requests_cancelled,
             "requests_requeued": self.requests_requeued,
             "requests_failed": self.requests_failed,
+            "requests_rehomed": self.requests_rehomed,
             "slot_quarantines": self.slot_quarantines,
             "slot_quarantine_releases": self.slot_quarantine_releases,
             "watchdog_trips": self.watchdog_trips,
@@ -158,3 +163,50 @@ class ServingStats:
         out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
         out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
         return out
+
+
+def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
+    """Aggregate N replicas' :class:`ServingStats` into one fleet view.
+
+    Counters sum; percentiles merge over the *raw* per-replica samples — a
+    mean of per-replica p99s is not a fleet p99, so the rollup needs the
+    sample lists, not the snapshots. Throughput divides total delivered
+    tokens by the longest replica's serving window (replicas serve
+    concurrently, so windows overlap rather than add); occupancy and queue
+    depth weight by each replica's step count. The dict mirrors
+    :meth:`ServingStats.snapshot`'s keys (plus ``replicas``) so fleet and
+    single-engine metrics diff column-for-column."""
+    out: dict = {"replicas": len(stats_list)}
+    if not stats_list:
+        return out
+    counters = (
+        "steps", "tokens_generated", "prefill_tokens", "requests_submitted",
+        "requests_completed", "requests_rejected", "requests_expired",
+        "requests_cancelled", "requests_requeued", "requests_failed",
+        "requests_rehomed", "slot_quarantines", "slot_quarantine_releases",
+        "watchdog_trips",
+    )
+    for key in counters:
+        out[key] = sum(getattr(s, key) for s in stats_list)
+    out["num_slots"] = sum(s.num_slots for s in stats_list)
+    out["max_active_slots"] = sum(s.max_active for s in stats_list)
+    elapsed = max(s.elapsed_seconds for s in stats_list)
+    out["throughput_tokens_per_sec"] = (
+        round(out["tokens_generated"] / elapsed, 3) if elapsed > 0 else 0.0
+    )
+    steps = out["steps"]
+    if steps:
+        out["slot_occupancy"] = round(
+            sum(s.occupancy_sum for s in stats_list) / steps, 4
+        )
+        out["queue_depth_mean"] = round(
+            sum(s.queue_depth_sum for s in stats_list) / steps, 3
+        )
+        out["decode_seconds"] = round(sum(s.decode_seconds for s in stats_list), 4)
+    for samples, prefix in (
+        ([t for s in stats_list for t in s.step_seconds], "per_token"),
+        ([t for s in stats_list for t in s.ttft_seconds], "ttft"),
+        ([t for s in stats_list for t in s.latency_seconds], "request_latency"),
+    ):
+        out.update(_percentiles_ms(samples, prefix))
+    return out

@@ -856,6 +856,58 @@ def bench_serving() -> dict:
     for q in (50, 90, 99):
         result[f"serving_ttft_p{q}_ms"] = saturated.get(f"ttft_p{q}_ms")
         result[f"serving_per_token_p{q}_ms"] = saturated.get(f"per_token_p{q}_ms")
+
+    # -- fleet: routed replicas + the replica-loss drill (fleet_ metrics) ----
+    # The same offered load through a health-aware router over N replicas,
+    # then again with FaultPlan SIGKILLing one replica mid-stream. Goodput
+    # retained is measured against the SINGLE-replica saturation point above
+    # (the acceptance bar: a 2-replica fleet losing one must not serve worse
+    # than one replica), failover cost as added request-latency p99. Every
+    # replica runs the same fixed-shape programs off the shared model jit
+    # cache, so the routed steady state must also compile nothing.
+    from accelerate_tpu.resilience import FaultPlan
+    from accelerate_tpu.serving import ServingRouter
+
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+    kill_step = int(os.environ.get("BENCH_FLEET_KILL_STEP", str(max_new // 2)))
+
+    def router(fault_plan=None):
+        return ServingRouter(
+            engine_factory=engine, num_replicas=replicas, fault_plan=fault_plan
+        )
+
+    healthy = run_offered_load(router(), prompts, max_new, float("inf"))
+    plan = FaultPlan(replica_kill_step=kill_step, replica_kill_index=replicas - 1)
+    drilled = router(plan)
+    drill = run_offered_load(drilled, prompts, max_new, float("inf"))
+    baseline_tok_s = saturated["throughput_tokens_per_sec"]
+    result.update(
+        {
+            "fleet_replicas": replicas,
+            "fleet_throughput_tok_s": healthy["throughput_tokens_per_sec"],
+            "fleet_slot_occupancy": healthy["slot_occupancy"],
+            # any replica's tracker sees the process-wide compile stream, so
+            # one count covers every replica — and it must be 0
+            "fleet_steady_state_compile_count": healthy["compile_count"],
+            "fleet_drill_kill_step": kill_step,
+            "fleet_drill_goodput_tok_s": drill["throughput_tokens_per_sec"],
+            "fleet_drill_goodput_retained": (
+                round(drill["throughput_tokens_per_sec"] / baseline_tok_s, 4)
+                if baseline_tok_s
+                else None
+            ),
+            "fleet_drill_offered": drill["offered_requests"],
+            "fleet_drill_terminated": drill["requests_completed"],
+            "fleet_drill_replica_deaths": drilled.replica_deaths,
+            "fleet_drill_failovers": drilled.failovers,
+            "fleet_drill_steady_state_compile_count": drill["compile_count"],
+            "fleet_failover_p99_added_latency_ms": round(
+                drill.get("request_latency_p99_ms", 0.0)
+                - saturated.get("request_latency_p99_ms", 0.0),
+                3,
+            ),
+        }
+    )
     return result
 
 

@@ -442,15 +442,22 @@ def test_run_offered_load_paced(llama):
 
 
 def test_run_offered_load_backpressure_counts_in_ttft(llama):
-    """A bounded queue under saturation defers arrivals instead of dropping
-    or re-rejecting them: everything completes, zero rejects are recorded,
-    and the deferred requests' TTFT includes the backlog wait (backdated
-    submit), so the tail TTFT strictly exceeds the unqueued one."""
+    """A bounded queue under saturation sheds with a retry_after hint, and
+    the loadgen honors it with jittered backoff instead of immediately
+    re-offering: everything still completes, sheds and retries are counted
+    separately and balance exactly (each shed schedules one retry), and the
+    deferred requests' TTFT includes the backlog wait (backdated submit), so
+    the tail TTFT strictly exceeds the unqueued one."""
     model, params = llama
     engine = ServingEngine(model, params, num_slots=1, max_len=32, max_queue=1)
     point = run_offered_load(engine, _prompts([4, 5, 6, 7], seed=14), 4)
     assert point["requests_completed"] == 4
-    assert point["requests_rejected"] == 0
+    assert point["offered_requests"] == 4
+    # exact offered-load accounting: the engine's shed count is the
+    # loadgen's, and every shed was re-offered exactly once
+    assert point["requests_rejected"] == point["loadgen_sheds"]
+    assert point["loadgen_sheds"] == point["loadgen_retries"]
+    assert point["loadgen_sheds"] > 0  # saturation really did shed
     # last-admitted request waited for ~3 predecessors × 4 decode steps
     assert point["ttft_p99_ms"] > point["ttft_p50_ms"]
 
