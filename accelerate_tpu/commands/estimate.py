@@ -41,6 +41,11 @@ def register_subcommand(subparsers):
         "--batch", type=int, default=1,
         help="Concurrent sequences (serving slots) for the KV-cache estimate",
     )
+    parser.add_argument(
+        "--page-size", type=int, default=16,
+        help="Tokens per KV page for the paged-pool estimate (the serving "
+        "engine's default layout); the dense slab is printed for comparison",
+    )
     parser.set_defaults(func=run)
     return parser
 
@@ -187,15 +192,33 @@ def run(args) -> int:
     # still decode with a bf16 cache).
     kv_batch = getattr(args, "batch", None) or 1
     kv_seq = getattr(args, "max_seq_len", None)
+    kv_page = getattr(args, "page_size", None) or 16
     kv_fn = None
     if config is not None and config.arch in ("llama", "gpt2"):
-        from ..serving.kv_cache import kv_cache_bytes
+        from ..serving.kv_cache import kv_cache_bytes, paged_kv_cache_bytes
 
         kv_seq = kv_seq or config.max_seq_len
-        kv_fn = lambda dtype_bytes: kv_cache_bytes(config, kv_batch, kv_seq, dtype_bytes)  # noqa: E731
+        dense_fn = lambda dtype_bytes: kv_cache_bytes(config, kv_batch, kv_seq, dtype_bytes)  # noqa: E731
+        # the serving engine pages by default, so the +kv column prices the
+        # paged pool (+ its int32 page tables); the dense slab stays printed
+        # for comparison — at capacity parity the pool costs one extra (null)
+        # page, and the savings come from provisioning below parity for the
+        # observed working set (bench: serving_paged_hbm_bytes_per_req)
+        kv_fn = lambda dtype_bytes: sum(  # noqa: E731
+            paged_kv_cache_bytes(
+                config, kv_batch, kv_seq, page_size=kv_page, dtype_bytes=dtype_bytes
+            )
+        )
+        pool, table = paged_kv_cache_bytes(config, kv_batch, kv_seq, page_size=kv_page)
         print(
             f"KV cache (batch={kv_batch}, seq={kv_seq}): "
-            f"{_convert_bytes(kv_fn(2))} bf16 / {_convert_bytes(kv_fn(4))} fp32"
+            f"{_convert_bytes(dense_fn(2))} bf16 / {_convert_bytes(dense_fn(4))} fp32 "
+            f"dense slab"
+        )
+        print(
+            f"Paged KV (page_size={kv_page}, capacity parity): pool "
+            f"{_convert_bytes(pool)} + page tables {_convert_bytes(table)} bf16 — "
+            f"a request only holds pages for tokens it produced"
         )
     elif kv_seq is not None:
         reason = (

@@ -54,6 +54,39 @@ def register_subcommand(subparsers):
         "--chaos-step", type=int, default=None,
         help="Fleet step the fault fires at (default: max-new-tokens // 2)",
     )
+    parser.add_argument(
+        "--mixed", action="store_true",
+        help="ROADMAP gating trace: mostly-short prompts with a long tail "
+        "(--long-fraction at --long-multiplier× the median length) — the "
+        "scenario chunked prefill exists for",
+    )
+    parser.add_argument(
+        "--long-fraction", type=float, default=0.1,
+        help="Fraction of prompts in the long tail (with --mixed)",
+    )
+    parser.add_argument(
+        "--long-multiplier", type=int, default=8,
+        help="Long prompts span long-multiplier..2×long-multiplier × the "
+        "median short length (with --mixed)",
+    )
+    parser.add_argument(
+        "--shared-prefix", type=int, default=0,
+        help="Prepend a common N-token system prompt to every request — a "
+        "paged engine prefills it once and COW-shares its pages",
+    )
+    parser.add_argument(
+        "--page-size", type=int, default=16, help="Tokens per KV page (paged layout)"
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=None,
+        help="Split long prompts into page-aligned chunks of this many tokens, "
+        "interleaved into the decode cadence (must be a multiple of --page-size)",
+    )
+    parser.add_argument(
+        "--no-paged", action="store_true",
+        help="Serve from the dense per-slot slab instead of the paged pool "
+        "(the comparison baseline)",
+    )
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--int8", action="store_true", help="int8 weight-only load path")
@@ -70,7 +103,13 @@ def run(args) -> int:
     import jax.numpy as jnp
 
     from ..models import build_model
-    from ..serving import ServingEngine, ServingRouter, make_prompts, run_offered_load
+    from ..serving import (
+        ServingEngine,
+        ServingRouter,
+        make_mixed_prompts,
+        make_prompts,
+        run_offered_load,
+    )
 
     if args.chaos is not None and args.replicas < 2:
         print(f"--chaos {args.chaos} needs --replicas >= 2 (a 1-replica fleet has no failover)")
@@ -93,18 +132,37 @@ def run(args) -> int:
         )
         params = params_from_streamed(streamed)
 
-    prompts = make_prompts(
-        args.requests, model.config.vocab_size, args.prompt_len_min, args.prompt_len_max,
-        seed=args.seed,
-    )
+    if args.mixed or args.shared_prefix:
+        prompts = make_mixed_prompts(
+            args.requests, model.config.vocab_size, args.prompt_len_min,
+            args.prompt_len_max,
+            long_fraction=args.long_fraction if args.mixed else 0.0,
+            long_multiplier=args.long_multiplier,
+            shared_prefix=args.shared_prefix,
+            seed=args.seed,
+        )
+    else:
+        prompts = make_prompts(
+            args.requests, model.config.vocab_size, args.prompt_len_min,
+            args.prompt_len_max, seed=args.seed,
+        )
+    longest = max(p.size for p in prompts)
+    max_len = max(args.max_len, longest + args.max_new_tokens)
+    if max_len > args.max_len:
+        print(
+            f"note: --max-len raised {args.max_len} -> {max_len} to fit the "
+            f"longest prompt ({longest} tokens) + max_new_tokens"
+        )
 
     def fresh_engine():
         # one model instance across engines: the jit cache lives on it, so
         # only the FIRST engine compiles — later sweep points (and every
         # extra replica) measure clean
         return ServingEngine(
-            model, params, num_slots=args.num_slots, max_len=args.max_len,
+            model, params, num_slots=args.num_slots, max_len=max_len,
             eos_token_id=args.eos_token_id, temperature=args.temperature,
+            paged=not args.no_paged, page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
         )
 
     def fresh_target(fault_plan=None):
@@ -165,11 +223,16 @@ def run(args) -> int:
     payload = {
         "model": args.model,
         "num_slots": args.num_slots,
-        "max_len": args.max_len,
+        "max_len": max_len,
         "requests": args.requests,
         "max_new_tokens": args.max_new_tokens,
         "replicas": args.replicas,
         "int8": bool(args.int8),
+        "paged": not args.no_paged,
+        "page_size": args.page_size if not args.no_paged else None,
+        "prefill_chunk": args.prefill_chunk,
+        "mixed": bool(args.mixed),
+        "shared_prefix": args.shared_prefix,
         # each sweep point's engine carries its own CompileTracker, scoped to
         # its lifetime: the saturation point's count IS the steady-state count
         # (for a fleet: any replica's tracker sees the process-wide stream, so
@@ -184,9 +247,21 @@ def run(args) -> int:
         print(json.dumps(payload))
         return 0
     fleet = f", {args.replicas} replicas" if args.replicas > 1 else ""
+    layout = (
+        f"paged(page_size={args.page_size}"
+        + (f", chunk={args.prefill_chunk}" if args.prefill_chunk else "")
+        + ")"
+        if not args.no_paged
+        else "dense slots"
+    )
+    scenario = (
+        (", mixed long/short" if args.mixed else "")
+        + (f", shared prefix {args.shared_prefix}" if args.shared_prefix else "")
+    )
     print(
-        f"serve-bench {args.model}: {args.num_slots} slots × {args.max_len} tokens{fleet}, "
-        f"{args.requests} requests, max_new={args.max_new_tokens}"
+        f"serve-bench {args.model}: {args.num_slots} slots × {max_len} tokens "
+        f"[{layout}]{fleet}, {args.requests} requests, "
+        f"max_new={args.max_new_tokens}{scenario}"
         + (", int8 weights" if args.int8 else "")
     )
     print(
@@ -207,6 +282,16 @@ def run(args) -> int:
             f"{point.get('ttft_p50_ms', 0):>7.1f}ms | {point.get('ttft_p99_ms', 0):>7.1f}ms | "
             f"{point.get('per_token_p50_ms', 0):>6.1f}ms | {point.get('per_token_p99_ms', 0):>6.1f}ms | "
             f"{point['slot_occupancy']:>9.2f}"
+        )
+    sat = points[-1]
+    if not args.no_paged and "page_occupancy" in sat:
+        print(
+            f"page economy (saturation): occupancy {sat['page_occupancy']:.2f}, "
+            f"peak {sat['peak_pages_in_use']}/{sat['num_pages'] - 1} pages, "
+            f"prefix hit rate {sat.get('prefix_hit_rate', 0.0):.2f} "
+            f"({sat.get('prefix_tokens_reused', 0)} tokens reused), "
+            f"{sat.get('prefill_chunks', 0)} prefill chunks, "
+            f"{sat.get('cow_page_copies', 0)} COW copies"
         )
     if drill is not None:
         retained = drill["goodput_retained"]

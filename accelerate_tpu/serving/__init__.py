@@ -14,8 +14,16 @@ handoff, speculative decoding) builds on these pieces.
 
 from .engine import ServingEngine, ServingResult, StepWatchdog, params_from_streamed
 from .fleet import EngineReplica, HealthPolicy, ReplicaLost, ReplicaState
-from .kv_cache import SlotAllocator, SlotKVCache, bucket_for, kv_cache_bytes, prefill_buckets
-from .loadgen import make_prompts, run_offered_load
+from .kv_cache import (
+    SlotAllocator,
+    SlotKVCache,
+    bucket_for,
+    kv_cache_bytes,
+    paged_kv_cache_bytes,
+    prefill_buckets,
+)
+from .loadgen import make_mixed_prompts, make_prompts, run_offered_load
+from .paging import PageAllocator, PagedKVCache, PrefixCache, pages_for
 from .router import RoutedRequest, ServingRouter
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
 
@@ -23,6 +31,9 @@ __all__ = [
     "ContinuousBatchingScheduler",
     "EngineReplica",
     "HealthPolicy",
+    "PageAllocator",
+    "PagedKVCache",
+    "PrefixCache",
     "QueueFull",
     "ReplicaLost",
     "ReplicaState",
@@ -36,7 +47,10 @@ __all__ = [
     "StepWatchdog",
     "bucket_for",
     "kv_cache_bytes",
+    "make_mixed_prompts",
     "make_prompts",
+    "paged_kv_cache_bytes",
+    "pages_for",
     "params_from_streamed",
     "prefill_buckets",
     "run_offered_load",

@@ -6,19 +6,31 @@ FLOPs until the whole batch hits ``max_new_tokens``. The engine inverts
 this: ONE fixed-shape decode program stays hot forever and requests
 multiplex through it via the slot cache —
 
+- **memory** is PAGED by default (``serving/paging.py``, docs/serving.md): a
+  fixed block pool ``[L, num_pages, page_size, KV, D]`` plus fixed-shape
+  int32 page tables that ride into the decode step like ``lengths`` — a
+  request holds pages for the tokens it actually produced, a shared system
+  prompt's pages are prefilled once and reference-counted (COW) across every
+  concurrent request, and admission is gated on free pages. ``paged=False``
+  keeps the original per-slot slab (``kv_cache.py``) as the bit-equal
+  comparison baseline;
 - **decode** is the models' own ``forward_with_cache`` protocol ``vmap``-ed
   over the slot axis with per-slot lengths: the protocol is reused
-  *unchanged* (each slot sees a batch-of-1 cache view and a scalar length),
-  and the program's shapes — ``[num_slots]`` tokens/lengths/active, the full
-  slot cache — never depend on which requests are in flight;
+  *unchanged* (each slot sees a batch-of-1 cache view — gathered through its
+  page table when paged — and a scalar length), and the program's shapes
+  never depend on which requests are in flight;
 - **prefill** runs the same protocol over a prompt padded to a power-of-two
-  bucket, into a private bucket-length cache, then one ``dynamic_update_slice``
-  inserts the K/V into the request's slot. Only ``prompt[:-1]`` prefills: the
-  request's first token falls out of its first decode step, so logits at
-  padded positions are never needed and prefill output is dropped entirely;
+  bucket. Paged, the written pages scatter straight into the pool, and a
+  ``prefill_chunk`` setting splits long prompts into page-aligned chunks
+  interleaved one-per-step into the decode cadence, so an already-admitted
+  request's token stream never stalls behind a monolithic 4k-token prefill.
+  Only ``prompt[:-1]`` prefills: the request's first token falls out of its
+  first decode step, so logits at padded positions are never needed and
+  prefill output is dropped entirely;
 - **scheduling** is host-side (``scheduler.py``): admission control, FIFO
-  admit into free slots, EOS/max-token retirement that frees the slot for
-  the very next step.
+  admit into free slots (and free pages), EOS/max-token retirement that
+  frees slot and pages for the very next step, and recompute-style
+  preemption of the youngest request under page pressure.
 
 After warmup (one prefill+insert program per bucket + one decode program),
 steady state compiles NOTHING — the acceptance invariant
@@ -55,6 +67,7 @@ from ..models.generation import make_sampler, resolve_decode_protocol
 from ..telemetry.serving import ServingStats
 from ..utils.jit_cache import dot_keyed_jit
 from .kv_cache import SlotKVCache, bucket_for, prefill_buckets
+from .paging import PagedKVCache, paged_buckets, pages_for
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request  # noqa: F401 (re-export)
 
 
@@ -195,6 +208,12 @@ class ServingEngine:
         max_probe_failures: int = 16,
         max_request_requeues: int = 2,
         name: Optional[str] = None,
+        paged: bool = True,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_sharing: bool = True,
+        prefix_cache_entries: int = 256,
     ):
         self.model = model
         # ``name`` tags this engine's telemetry records — a routed fleet sets
@@ -206,10 +225,32 @@ class ServingEngine:
         self._sample = make_sampler(temperature)
         self._init_cache, self._fwc = resolve_decode_protocol(model)
         dtype = dtype if dtype is not None else params["embed_tokens"].dtype
-        self.cache = SlotKVCache(self._init_cache, num_slots, max_len, dtype=dtype)
-        self.buckets = tuple(buckets) if buckets is not None else prefill_buckets(max_len - 1)
-        if max(self.buckets) > max_len:
-            raise ValueError(f"largest bucket {max(self.buckets)} exceeds max_len {max_len}")
+        self.paged = paged
+        base_buckets = tuple(buckets) if buckets is not None else prefill_buckets(max_len - 1)
+        if paged:
+            self.cache = PagedKVCache(
+                self._init_cache, num_slots, max_len, page_size=page_size,
+                num_pages=num_pages, dtype=dtype, prefix_entries=prefix_cache_entries,
+            )
+            if prefill_chunk is not None:
+                if prefill_chunk < page_size or prefill_chunk % page_size:
+                    raise ValueError(
+                        f"prefill_chunk {prefill_chunk} must be a multiple of "
+                        f"page_size {page_size}"
+                    )
+                base_buckets = base_buckets + (prefill_chunk,)
+            # prefill spans scatter whole pages, so buckets round to page
+            # multiples (capped at the pool-backed view length)
+            self.buckets = paged_buckets(base_buckets, page_size, self.cache.view_len)
+            self.prefill_chunk = prefill_chunk
+            self.prefix_sharing = prefix_sharing
+        else:
+            self.cache = SlotKVCache(self._init_cache, num_slots, max_len, dtype=dtype)
+            self.buckets = base_buckets
+            if max(self.buckets) > max_len:
+                raise ValueError(f"largest bucket {max(self.buckets)} exceeds max_len {max_len}")
+            self.prefill_chunk = None
+            self.prefix_sharing = False
         self.scheduler = ContinuousBatchingScheduler(num_slots, max_queue=max_queue)
         self._pending = np.zeros((num_slots,), np.int32)  # next input token per slot
         self._rng = rng if rng is not None else jax.random.key(0)
@@ -217,7 +258,11 @@ class ServingEngine:
         # cache donation halves decode HBM traffic; unsupported on CPU (warns)
         self._donate = jax.default_backend() in ("tpu", "gpu")
         self.telemetry = telemetry
-        self.stats = ServingStats(num_slots)
+        self.stats = ServingStats(
+            num_slots,
+            num_pages=self.cache.num_pages if paged else None,
+            page_size=page_size if paged else None,
+        )
         if telemetry is not None:
             self.compiles = telemetry.compiles
         else:
@@ -248,6 +293,7 @@ class ServingEngine:
         self._decode_warm = False  # first decode completed (compile behind us)
         self._donation_checked = False  # one consult after the first compile
         self._draining = False  # drain(): stop admitting, finish active slots
+        self._warming = False  # warmup(): synthetic prompts skip the prefix cache
 
     # -- jitted programs (dot-keyed: shared cache with generate()) ----------
 
@@ -342,6 +388,144 @@ class ServingEngine:
             self._prefill_caches[bucket] = self._init_cache(1, bucket, dtype=self.cache.dtype)
         return self._prefill_caches[bucket]
 
+    # -- paged programs (serving/paging.py; docs/serving.md) ----------------
+    #
+    # Every paged program takes the page tables as a fixed-shape int32 ARG
+    # (never a closed-over constant — `analyze --self-check`'s baked-constant
+    # scan would flag it), gathers a slot's pages into a contiguous view, and
+    # runs the models' decode protocol UNCHANGED over that view. Masked
+    # positions beyond a slot's length read whatever the gathered pages hold,
+    # but contribute exactly-zero softmax weight, so paged and slot decode
+    # are bit-equal at temperature 0 — provided every reachable page stays
+    # FINITE (0 × NaN = NaN): inactive/probe lanes therefore write sanitized
+    # zeros to the null page, and quarantine scrubs freed pages on device.
+
+    @staticmethod
+    def _gathered_view(pool_k, pool_v, row, length):
+        """One slot's cache dict: pages gathered through its table row into
+        the contiguous ``[L, 1, view_len, ...]`` layout the protocol expects.
+        Static on purpose: the paged programs close over it, and those live
+        in the model-lifetime jit cache — a bound method would pin the whole
+        engine (KV pool included) long after the engine is discarded."""
+        taken_k = jnp.take(pool_k, row, axis=1)  # [L, pps, ps, ...]
+        taken_v = jnp.take(pool_v, row, axis=1)
+        shape = (taken_k.shape[0], 1, taken_k.shape[1] * taken_k.shape[2]) + taken_k.shape[3:]
+        return {"k": taken_k.reshape(shape), "v": taken_v.reshape(shape), "length": length}
+
+    def _paged_decode_program(self):
+        fwc, sample = self._fwc, self._sample
+        ps = self.cache.page_size
+        gathered = self._gathered_view
+
+        def build():
+            def decode_step(params, pk, pv, tokens, lengths, active, tables, keys):
+                def one_slot(token, row, length, key):
+                    cache = gathered(pk, pv, row, length)
+                    logits, nc = fwc(params, token[None, None], cache)
+                    ok = jnp.all(jnp.isfinite(logits))
+                    # only position `length` changed: extract it for the
+                    # write-back scatter instead of re-scattering the view
+                    wk = jax.lax.dynamic_slice_in_dim(nc["k"][:, 0], length, 1, axis=1)[:, 0]
+                    wv = jax.lax.dynamic_slice_in_dim(nc["v"][:, 0], length, 1, axis=1)[:, 0]
+                    return sample(logits, key)[0], ok, wk, wv
+
+                nxt, ok, wk, wv = jax.vmap(one_slot)(tokens, tables, lengths, keys)
+                # write-back: active slots append at (table[length // ps],
+                # length % ps); inactive and probe lanes redirect to the null
+                # page — with ZEROED values, so the shared null page stays
+                # finite whatever a poisoned lane produced
+                wpage = jnp.take_along_axis(tables, (lengths // ps)[:, None], axis=1)[:, 0]
+                wpage = jnp.where(active, wpage, 0)
+                woff = jnp.where(active, lengths % ps, 0)
+                lane = active.reshape((-1,) + (1,) * (wk.ndim - 1))
+                wk = jnp.where(lane, wk.astype(pk.dtype), jnp.zeros((), pk.dtype))
+                wv = jnp.where(lane, wv.astype(pv.dtype), jnp.zeros((), pv.dtype))
+                pk = pk.at[:, wpage, woff].set(jnp.moveaxis(wk, 0, 1))
+                pv = pv.at[:, wpage, woff].set(jnp.moveaxis(wv, 0, 1))
+                return jnp.where(active, nxt, jnp.int32(0)), ok, pk, pv
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(decode_step, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_paged_decode", self.cache.num_slots, self.cache.view_len, ps,
+             self.temperature, self._donate),
+            build,
+        )
+
+    def _paged_prefill_program(self, span: int):
+        """Prefill ``span`` tokens (one chunk, or a whole bucketed suffix)
+        starting at the PAGE-ALIGNED position ``start``, scattering the
+        ``span // page_size`` written pages back into the pool. The cache
+        view is the full gathered table, so a shared/chunked prefix is
+        attended exactly as a monolithic prefill would — split points change
+        nothing but which pages get written."""
+        fwc = self._fwc
+        ps = self.cache.page_size
+        n_pages = span // ps
+        gathered = self._gathered_view
+
+        def build():
+            def prefill(params, ids, pk, pv, row, start):
+                _, nc = fwc(params, ids, gathered(pk, pv, row, start))
+                new_k = jax.lax.dynamic_slice_in_dim(nc["k"][:, 0], start, span, axis=1)
+                new_v = jax.lax.dynamic_slice_in_dim(nc["v"][:, 0], start, span, axis=1)
+                shape = (new_k.shape[0], n_pages, ps) + new_k.shape[2:]
+                wids = jax.lax.dynamic_slice_in_dim(row, start // ps, n_pages)
+                pk = pk.at[:, wids].set(new_k.reshape(shape).astype(pk.dtype))
+                pv = pv.at[:, wids].set(new_v.reshape(shape).astype(pv.dtype))
+                return pk, pv
+
+            donate = (2, 3) if self._donate else ()
+            return jax.jit(prefill, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_paged_prefill", span, self.cache.num_slots, self.cache.view_len,
+             ps, self._donate),
+            build,
+        )
+
+    def _page_copy_program(self):
+        """Copy one page ``src → dst``: the on-device half of copy-on-write
+        (a write landing in a shared page copies THAT page only). Compiled
+        lazily — steady-state page-aligned sharing never triggers it."""
+
+        def build():
+            def copy(pk, pv, src, dst):
+                pk = pk.at[:, dst].set(pk[:, src])
+                pv = pv.at[:, dst].set(pv[:, src])
+                return pk, pv
+
+            donate = (0, 1) if self._donate else ()
+            return jax.jit(copy, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_page_copy", self.cache.num_pages, self.cache.page_size, self._donate),
+            build,
+        )
+
+    def _page_scrub_program(self):
+        """Zero every page selected by a boolean mask — quarantine must scrub
+        freed pages before the pool recycles them (masked attention weight is
+        exactly 0.0, but 0 × NaN is still NaN, so masking alone cannot
+        contain non-finite K/V). One fixed-shape program covers any set of
+        pages; compiled lazily on the first quarantine."""
+
+        def build():
+            def scrub(pk, pv, mask):
+                m = mask.reshape((1, -1) + (1,) * (pk.ndim - 2))
+                pk = jnp.where(m, jnp.zeros((), pk.dtype), pk)
+                pv = jnp.where(m, jnp.zeros((), pv.dtype), pv)
+                return pk, pv
+
+            donate = (0, 1) if self._donate else ()
+            return jax.jit(scrub, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_page_scrub", self.cache.num_pages, self.cache.page_size, self._donate),
+            build,
+        )
+
     # -- request intake ----------------------------------------------------
 
     def warmup(self) -> None:
@@ -349,11 +533,43 @@ class ServingEngine:
         single-token request per prefill bucket (plus the shared decode
         step). After this, steady state compiles nothing regardless of the
         traffic mix — benchmarks call it so no measurement window ever
-        straddles a compile."""
-        for bucket in self.buckets:
-            length = min(bucket + 1, self.cache.max_len)
-            self.submit(np.zeros((length,), np.int32), max_new_tokens=1)
-        self.run()
+        straddles a compile. Each bucket's prompt uses a DISTINCT token so
+        paged prefix sharing cannot short-circuit a larger bucket's prefill
+        into a cached smaller one (which would leave its program uncompiled);
+        a paged engine additionally compiles EVERY prefill span program
+        (all buckets plus the chunk) directly, because traffic's schedules
+        — a prefix-hit tail, or ``_next_span``'s monolithic fallback — can
+        select spans the synthetic requests' own schedules skip. Warmup
+        prompts stay
+        OUT of the prefix cache: registering them would pin a registry
+        reference per page of every bucket-length prompt — pool capacity
+        (and the page-occupancy signals built on it) held by K/V no real
+        traffic will ever reuse."""
+        self._warming = True
+        try:
+            for i, bucket in enumerate(self.buckets):
+                length = min(bucket + 1, self.cache.max_len)
+                self.submit(np.full((length,), i + 1, np.int32), max_new_tokens=1)
+            self.run()
+            if self.paged:
+                # the synthetic requests above only compile the spans THEIR
+                # schedules select; traffic can reach others (a prefix hit
+                # or coarse buckets route _next_span to a monolithic span
+                # the chunk cadence skipped). Compile every span program
+                # directly, writing into the null page — the designated
+                # sink, left finite by the zero-id prefill.
+                spans = set(self.buckets)
+                if self.prefill_chunk is not None:
+                    spans.add(self.prefill_chunk)
+                row = np.zeros((self.cache.pages_per_slot,), np.int32)
+                for span in sorted(spans):
+                    ids = np.zeros((1, span), np.int32)
+                    self.cache.k, self.cache.v = self._paged_prefill_program(span)(
+                        self.params, ids, self.cache.k, self.cache.v, row,
+                        np.int32(0),
+                    )
+        finally:
+            self._warming = False
 
     @property
     def queue_available(self) -> bool:
@@ -397,6 +613,28 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the slot capacity max_len={self.cache.max_len}"
             )
+        if self.paged:
+            # feasibility, not pressure: a request the POOL can never hold
+            # must shed here — queued, it would deadlock admission forever.
+            # Two bounds matter: the total tokens the request will ever pin,
+            # AND the peak page count across the prefill schedule — every
+            # span is BUCKETED (padded up), so the FINAL chunk's padding can
+            # push the table past the raw token count mid-flight (chunked
+            # prefill still shrinks the peak vs one monolithic bucket, which
+            # is itself a reason to chunk on small pools)
+            ps = self.cache.page_size
+            need = max(pages_for(prefill_len + max_new_tokens, ps), 1)
+            done = 0
+            while done < prefill_len:
+                span = self._next_span(prefill_len - done, done)
+                need = max(need, (done + span) // ps)
+                done += min(span, prefill_len - done)
+            if need > self.cache.num_pages - 1:
+                raise ValueError(
+                    f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                    f"needs {need} pages but the pool holds "
+                    f"{self.cache.num_pages - 1} × {ps} tokens"
+                )
         if self._draining:
             self.stats.record_reject()
             hint = self.retry_after_hint()
@@ -504,7 +742,98 @@ class ServingEngine:
         waves = math.ceil((self.scheduler.waiting + 1) / self.cache.num_slots)
         return round(max(waves * mean_tokens * mean_step, mean_step), 4)
 
+    def _free_slot(self, request: Request):
+        """The ``admit_ready`` callback: claim capacity for one queued
+        request, or None to leave it waiting. Slot mode = a free slot; paged
+        mode = a free lane AND pages for the first prefill span (admission
+        gated on pages, with a prefix-cache lookup deciding how many the
+        request actually needs)."""
+        prefill_len = request.prompt.size - 1
+        if not self.paged:
+            return self.cache.admit(prefill_len)
+        if self.cache.lanes.free_count == 0:
+            # saturation fast path: no lane means no admission — skip the
+            # prefix hash walk (which would also LRU-touch entries for a
+            # request that is not admitted this step)
+            return None
+        ps = self.cache.page_size
+        sharing = self.prefix_sharing and not self._warming
+        hit_len, shared = 0, []
+        if sharing and prefill_len >= ps:
+            hit_len, shared = self.cache.prefix.lookup(request.prompt[:prefill_len])
+        # a huge hit can leave a tail whose bucket-padded span overflows the
+        # fixed-width table; re-prefill enough of the prefix that the rest of
+        # the schedule fits (position 0 always does)
+        while hit_len and not self._prefill_fits(prefill_len - hit_len, hit_len):
+            hit_len -= ps
+        shared = shared[: hit_len // ps]
+        suffix = prefill_len - hit_len
+        if suffix > 0:
+            new_pages = self._next_span(suffix, hit_len) // ps
+        else:
+            new_pages = 1  # fully cached prefill: just the first decode-write page
+        slot = self.cache.admit(shared, new_pages)
+        if slot is None:
+            return None
+        request.prefilled = hit_len
+        request.prefix_hit = hit_len
+        if hit_len:
+            self.stats.record_prefix_hit(hit_len)
+        elif sharing and prefill_len >= ps:
+            self.stats.record_prefix_miss()
+        return slot
+
+    def _next_span(self, remaining: int, position: int) -> int:
+        """Tokens the next prefill program call covers, starting at
+        ``position``: a full chunk while more than a chunk remains AND the
+        chunk cadence's final (bucket-padded) span still lands inside the
+        fixed-width page table; else the bucket fitting the tail. Always a
+        page multiple (paged buckets are), so chunk starts stay page-aligned.
+        The capacity guard matters when ``view_len`` is not a chunk multiple:
+        an unchecked cadence would walk ``position`` to where the padded tail
+        overflows the table — such a request degrades to one monolithic
+        bucket span (compiled at warmup like any other bucket) instead."""
+        if (
+            self.prefill_chunk is not None
+            and remaining > self.prefill_chunk
+            and self._chunk_cadence_fits(remaining, position)
+        ):
+            return self.prefill_chunk
+        return bucket_for(remaining, self.buckets)
+
+    def _chunk_cadence_fits(self, remaining: int, position: int) -> bool:
+        """Whether chunked prefill of ``remaining`` tokens from ``position``
+        stays within ``view_len``: full chunks advance to the final span,
+        whose BUCKET padding is what can overflow the table."""
+        chunk = self.prefill_chunk
+        full = (remaining - 1) // chunk
+        tail = remaining - full * chunk
+        return (
+            position + full * chunk + bucket_for(tail, self.buckets)
+            <= self.cache.view_len
+        )
+
+    def _prefill_fits(self, remaining: int, position: int) -> bool:
+        """Whether SOME prefill schedule for ``remaining`` tokens starting at
+        ``position`` fits the page table — the chunk cadence or the
+        monolithic bucket. Admission caps a prefix hit until this holds
+        (always true at position 0: buckets are capped at ``view_len``)."""
+        if remaining <= 0:
+            return True
+        if (
+            self.prefill_chunk is not None
+            and remaining > self.prefill_chunk
+            and self._chunk_cadence_fits(remaining, position)
+        ):
+            return True
+        return position + bucket_for(remaining, self.buckets) <= self.cache.view_len
+
     def _admit(self, slot: int, request: Request) -> None:
+        if self.paged:
+            # prefill runs in _advance_prefills (chunked: one span per step;
+            # monolithic: the whole suffix this same step) — admission only
+            # claimed capacity
+            return
         prefill_len = request.prompt.size - 1
         if prefill_len > 0:
             bucket = bucket_for(prefill_len, self.buckets)
@@ -521,6 +850,165 @@ class ServingEngine:
         # the prompt's last token is the first decode input: its logits ARE
         # the request's first token, so prefill logits are never consumed
         self._pending[slot] = request.prompt[-1]
+
+    # -- paged prefill / page-pressure machinery ----------------------------
+
+    def _advance_prefills(self) -> list[ServingResult]:
+        """Run ONE prefill span per still-prefilling slot (chunked prefill:
+        long prompts spread over the step cadence, so already-admitted
+        requests keep decoding every step instead of stalling behind a
+        monolithic prefill; without ``prefill_chunk`` the single span
+        completes immediately). Returns requests failed by page pressure."""
+        failed: list[ServingResult] = []
+        for slot in list(self.scheduler.active_slots):
+            request = self.scheduler.slots[slot]
+            if request is None or self.cache.active[slot]:
+                continue
+            prefill_len = request.prompt.size - 1
+            remaining = prefill_len - request.prefilled
+            if remaining <= 0:
+                self._finish_prefill(slot, request)
+                continue
+            span = self._next_span(remaining, request.prefilled)
+            # pages for this span beyond what admission / earlier chunks
+            # allocated (request.prefilled is page-aligned here: chunks and
+            # hits are both page multiples)
+            target = (request.prefilled + span) // self.cache.page_size
+            need = target - int(self.cache.held[slot])
+            if need > 0 and not self.cache.grow(slot, need):
+                self.stats.record_page_pressure()
+                status = self._reclaim_pages(
+                    slot, request, retry=lambda: self.cache.grow(slot, need)
+                )
+                if status == "failed":
+                    failed.append(self._fail_for_pages(slot, request))
+                    continue
+                if status == "yielded":
+                    continue  # requeued at the head; elders decode this step
+            take = min(span, remaining)
+            ids = np.zeros((1, span), np.int32)
+            ids[0, :take] = request.prompt[request.prefilled : request.prefilled + take]
+            # a span is a CHUNK only when the request's prefill is actually
+            # split: more remains after it, or it continues earlier spans —
+            # a single-span (monolithic or fallback) prefill is not chunked
+            # activity, and counting it (or warmup's synthetic schedules)
+            # would overstate how much chunking ran
+            chunked_span = not self._warming and (
+                take < remaining or request.prefilled > request.prefix_hit
+            )
+            self.cache.k, self.cache.v = self._paged_prefill_program(span)(
+                self.params, ids, self.cache.k, self.cache.v,
+                self.cache.tables[slot], np.int32(request.prefilled),
+            )
+            request.prefilled += take
+            self.stats.record_prefill(span)
+            if chunked_span:
+                self.stats.record_prefill_chunk()
+            if request.prefilled >= prefill_len:
+                self._finish_prefill(slot, request)
+        return failed
+
+    def _finish_prefill(self, slot: int, request: Request) -> None:
+        """Every prompt token is in cache pages: register the aligned prefix
+        for future sharers and make the slot decode-visible."""
+        prefill_len = request.prompt.size - 1
+        if self.prefix_sharing and not self._warming:
+            blocks = prefill_len // self.cache.page_size
+            if blocks:
+                self.cache.prefix.register_chain(
+                    request.prompt[: blocks * self.cache.page_size],
+                    self.cache.tables[slot, :blocks],
+                )
+        self.cache.lengths[slot] = prefill_len
+        self.cache.active[slot] = True
+        self._pending[slot] = request.prompt[-1]
+
+    def _preempt_slot(self, slot: int, reason: str) -> None:
+        """Recompute-style eviction: back to the queue head, pages freed."""
+        preempted = self.scheduler.preempt_slot(slot)
+        self.cache.retire(slot)
+        self._pending[slot] = 0
+        self.stats.record_preempted()
+        self._resilience(
+            {"event": "preempted", "request_id": preempted.id, "slot": slot,
+             "reason": reason}
+        )
+
+    def _reclaim_pages(self, slot: int, request: Request, retry) -> str:
+        """Page pressure on ``slot``: free pages by seniority and re-run
+        ``retry()``. Victims must be strictly YOUNGER than the requester
+        (submission order = request id — requeues keep it), youngest first:
+        the oldest active request can never be evicted, so it always makes
+        progress and the engine cannot livelock two page-hungry requests
+        into preempting each other forever. When the requester is itself the
+        youngest, IT yields to its elders (``"yielded"``: requeued at the
+        head, re-admitted once pages free); ``"failed"`` only when it is the
+        lone active request and the pool is still dry — genuine overload,
+        nothing left to reclaim."""
+        while True:
+            active = [
+                s for s in self.scheduler.active_slots
+                if s != slot and self.scheduler.slots[s] is not None
+            ]
+            younger = [s for s in active if self.scheduler.slots[s].id > request.id]
+            if younger:
+                victim = max(younger, key=lambda s: self.scheduler.slots[s].id)
+                self._preempt_slot(victim, "page_pressure")
+                if retry():
+                    return "ok"
+                continue
+            if active:
+                self._preempt_slot(slot, "page_pressure_yield")
+                return "yielded"
+            return "failed"
+
+    def _fail_for_pages(self, slot: int, request: Request) -> ServingResult:
+        """Nothing left to preempt and the pool is still dry: the request
+        fails loudly (feasibility was checked at submit, so this is genuine
+        overload of prefix-cache-pinned pages, not an impossible request)."""
+        self.cache.retire(slot)
+        done = self.scheduler.retire(slot, "failed")
+        self._pending[slot] = 0
+        self.stats.record_failed()
+        self._resilience(
+            {"event": "failed", "slot": slot, "request_id": done.id,
+             "reason": "page_pressure"}
+        )
+        return self._result_for(done)
+
+    def _prepare_decode_writes(self) -> list[ServingResult]:
+        """Before decoding, make every decode-visible slot's write position
+        backed by a PRIVATE page: grow across page boundaries, and resolve
+        copy-on-write — a write landing in a shared page copies that page
+        only, on device, leaving every other holder untouched. Returns
+        requests failed by page pressure."""
+        failed: list[ServingResult] = []
+        for slot in list(self.scheduler.active_slots):
+            request = self.scheduler.slots[slot]
+            if request is None or not self.cache.active[slot]:
+                continue
+            status, src, dst = self.cache.prepare_write(slot)
+            if status == "pressure":
+                self.stats.record_page_pressure()
+                outcome: list = []
+
+                def retry(slot=slot, outcome=outcome):
+                    outcome[:] = [self.cache.prepare_write(slot)]
+                    return outcome[0][0] != "pressure"
+
+                reclaimed = self._reclaim_pages(slot, request, retry=retry)
+                if reclaimed == "failed":
+                    failed.append(self._fail_for_pages(slot, request))
+                    continue
+                if reclaimed == "yielded":
+                    continue  # requeued at the head; elders decode this step
+                status, src, dst = outcome[0]
+            if status == "cow":
+                self.cache.k, self.cache.v = self._page_copy_program()(
+                    self.cache.k, self.cache.v, np.int32(src), np.int32(dst)
+                )
+                self.stats.record_cow_copy()
+        return failed
 
     # -- the engine loop ---------------------------------------------------
 
@@ -608,14 +1096,24 @@ class ServingEngine:
         t0 = time.perf_counter()
         finished: list[ServingResult] = self._retire_degraded(t0)
         self._inject_chaos_burst()
-        for slot, request in self.scheduler.admit_ready(
-            lambda req: self.cache.admit(req.prompt.size - 1)
-        ):
+        for slot, request in self.scheduler.admit_ready(self._free_slot):
             self._admit(slot, request)
+        if self.paged:
+            # one prefill span per still-prefilling slot (chunked prefill
+            # interleaves long prompts into the step cadence), then make
+            # every decode write position privately backed (grow / COW)
+            finished.extend(self._advance_prefills())
+            finished.extend(self._prepare_decode_writes())
 
         active_idx = self.scheduler.active_slots
         quarantined = sorted(self.cache.quarantined)
         if not active_idx and not quarantined:
+            return finished
+        if self.paged and not quarantined and not any(
+            self.cache.active[s] for s in active_idx
+        ):
+            # every occupied slot is still prefilling: no lane would decode,
+            # so skip the device step — the next step() runs their next chunk
             return finished
         if not active_idx and quarantined and self.scheduler.waiting:
             # fail loudly rather than spin run() forever: every slot is
@@ -636,15 +1134,27 @@ class ServingEngine:
         if self._watchdog is not None and self._decode_warm:
             self._watchdog.arm()
         keys = jax.random.split(jax.random.fold_in(self._rng, self._steps), self.cache.num_slots)
-        nxt, ok, self.cache.k, self.cache.v = self._decode_program()(
-            self.params,
-            self.cache.k,
-            self.cache.v,
-            self._pending,
-            self.cache.lengths,
-            self.cache.active,
-            keys,
-        )
+        if self.paged:
+            nxt, ok, self.cache.k, self.cache.v = self._paged_decode_program()(
+                self.params,
+                self.cache.k,
+                self.cache.v,
+                self._pending,
+                self.cache.lengths,
+                self.cache.active,
+                self.cache.tables,
+                keys,
+            )
+        else:
+            nxt, ok, self.cache.k, self.cache.v = self._decode_program()(
+                self.params,
+                self.cache.k,
+                self.cache.v,
+                self._pending,
+                self.cache.lengths,
+                self.cache.active,
+                keys,
+            )
         tokens = np.asarray(nxt)  # host fetch = the per-step fence + EOS gate
         finite = np.asarray(ok)
         if self._watchdog is not None:
@@ -672,6 +1182,11 @@ class ServingEngine:
         delivered = 0
         for slot in active_idx:
             request = self.scheduler.slots[slot]
+            if request is None or not self.cache.active[slot]:
+                # a still-prefilling paged slot (or a page-pressure casualty):
+                # its lane ran as inactive this step — no token to deliver,
+                # no verdict to act on
+                continue
             if not finite[slot]:
                 # poisoned slot: quarantine + scrub it (0 × NaN = NaN, so
                 # masked poison would otherwise fail every probe forever).
@@ -693,10 +1208,21 @@ class ServingEngine:
                     self._resilience(
                         {"event": "quarantine", "slot": slot, "request_id": request.id}
                     )
-                self.cache.quarantine(slot)
-                self.cache.k, self.cache.v = self._scrub_program()(
-                    self.cache.k, self.cache.v, np.int32(slot)
-                )
+                if self.paged:
+                    # releases the lane AND the pages; fully-freed pages must
+                    # scrub on device before the pool recycles them
+                    freed = self.cache.quarantine(slot)
+                    if freed:
+                        mask = np.zeros((self.cache.num_pages,), bool)
+                        mask[freed] = True
+                        self.cache.k, self.cache.v = self._page_scrub_program()(
+                            self.cache.k, self.cache.v, mask
+                        )
+                else:
+                    self.cache.quarantine(slot)
+                    self.cache.k, self.cache.v = self._scrub_program()(
+                        self.cache.k, self.cache.v, np.int32(slot)
+                    )
                 self._pending[slot] = 0
                 self._probe_failures[slot] = 0
                 self.stats.record_quarantine()
@@ -750,6 +1276,7 @@ class ServingEngine:
         self.stats.record_step(
             now - t0, active=len(active_idx), waiting=self.scheduler.waiting,
             tokens=delivered,
+            pages_in_use=self.cache.pages_in_use if self.paged else None,
         )
         return finished
 
@@ -782,9 +1309,22 @@ class ServingEngine:
     # -- program analysis (analysis/: docs/analysis.md) --------------------
 
     def _lower_decode(self):
-        """AOT-lower the decode program against the live slot cache — the
-        audit's view of exactly the program ``step()`` runs."""
+        """AOT-lower the decode program against the live cache — the audit's
+        view of exactly the program ``step()`` runs. For a paged engine the
+        page tables ride as an argument here just as in ``step()``, so the
+        baked-constant scan proves no table ever froze into the program."""
         keys = jax.random.split(self._rng, self.cache.num_slots)
+        if self.paged:
+            return self._paged_decode_program().lower(
+                self.params,
+                self.cache.k,
+                self.cache.v,
+                self._pending,
+                self.cache.lengths,
+                self.cache.active,
+                self.cache.tables,
+                keys,
+            )
         return self._decode_program().lower(
             self.params,
             self.cache.k,
@@ -794,6 +1334,36 @@ class ServingEngine:
             self.cache.active,
             keys,
         )
+
+    def kv_page_layout(self, request_id: int) -> Optional[dict]:
+        """The page-granular layout of one in-flight request's live KV — the
+        concrete payload a prefill/decode-pool handoff relays through
+        :meth:`~.router.ServingRouter._kv_handoff` (arXiv:2112.01075: moving
+        a request's cache between pools is an array-redistribution problem,
+        and this dict is its source description: which physical pages, in
+        what order, holding how many valid positions, in what per-page
+        shape). None when the engine is unpaged or the request holds no
+        pages here."""
+        if not self.paged:
+            return None
+        for slot, request in enumerate(self.scheduler.slots):
+            if request is None or request.id != request_id:
+                continue
+            pages = self.cache.pages_of(slot)
+            if not pages:
+                return None
+            return {
+                "slot": slot,
+                "pages": pages,
+                "page_size": self.cache.page_size,
+                "length": int(self.cache.lengths[slot]),
+                "prefilled": request.prefilled,
+                "page_shape": tuple(
+                    int(d) for i, d in enumerate(self.cache.k.shape) if i != 1
+                ),
+                "dtype": str(self.cache.dtype),
+            }
+        return None
 
     def _consult_donation(self) -> None:
         """Lowering-level check: catches donations dropped at trace time (no
@@ -872,14 +1442,21 @@ class ServingEngine:
         if include_prefill:
             for bucket in self.buckets:
                 ids = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
-                lowered = self._prefill_program(bucket).lower(
-                    self.params, ids, self._prefill_cache(bucket)
-                )
+                if self.paged:
+                    lowered = self._paged_prefill_program(bucket).lower(
+                        self.params, ids, self.cache.k, self.cache.v,
+                        self.cache.tables[0], np.int32(0),
+                    )
+                else:
+                    lowered = self._prefill_program(bucket).lower(
+                        self.params, ids, self._prefill_cache(bucket)
+                    )
                 sub = audit_lowered(
                     lowered,
                     compile=False,
                     label=f"serving_prefill_{bucket}",
-                    expect_donation=False,
+                    # the paged prefill donates the pools it scatters into
+                    expect_donation=self.paged and self._donate,
                     **audit_kwargs,
                 )
                 report.merge(sub, prefix=f"prefill_{bucket}")

@@ -1,5 +1,12 @@
 """Slot-based KV cache for continuous-batching inference.
 
+This is the DENSE layout — the engine now defaults to the paged layout
+(``serving/paging.py``: a block pool + fixed-shape page tables + COW prefix
+sharing), and keeps this slab as the ``paged=False`` comparison baseline:
+``tests/test_paging.py`` pins the two bit-equal at temperature 0. This
+module also holds the shared sizing formulas (dense and paged) that the
+estimate CLI and bench price serving with.
+
 The cache is ONE preallocated region per layer — ``[L, num_slots, max_len,
 KV, D]`` — plus per-slot ``lengths``/``active`` host mirrors. A request of
 any prompt length occupies one slot without reshaping anything, so the decode
@@ -54,13 +61,46 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
 def kv_cache_bytes(
     config, batch: int, max_seq_len: Optional[int] = None, dtype_bytes: int = 2
 ) -> int:
-    """Device bytes of a full KV cache: ``2 (k+v) × layers × kv_heads ×
-    head_dim × max_len × batch × dtype_bytes``. Shared with
+    """Device bytes of the DENSE (slot-slab) KV cache: ``2 (k+v) × layers ×
+    kv_heads × head_dim × max_len × batch × dtype_bytes``. Kept as the
+    comparison baseline now that the engine pages by default — the paged
+    sizing is :func:`paged_kv_cache_bytes`. Shared with
     ``accelerate-tpu estimate-memory`` so serve sizing includes the cache."""
     seq = max_seq_len if max_seq_len is not None else config.max_seq_len
     return int(
         2 * config.num_layers * config.kv_heads * config.dim_per_head * seq * batch * dtype_bytes
     )
+
+
+def paged_kv_cache_bytes(
+    config,
+    batch: int,
+    max_seq_len: Optional[int] = None,
+    page_size: int = 16,
+    num_pages: Optional[int] = None,
+    dtype_bytes: int = 2,
+) -> tuple[int, int]:
+    """Device bytes of a paged KV pool: ``(pool_bytes, table_bytes)``.
+
+    ``num_pages`` defaults to capacity parity with the dense slab —
+    ``batch × ceil(S / page_size)`` pages plus the reserved null page — which
+    is the worst-case bound; provisioning the pool for the observed working
+    set (bench records ``serving_paged_hbm_bytes_per_req``) is where the
+    savings come from, since a request only ever holds pages for tokens it
+    actually produced. ``table_bytes`` is the int32 page-table overhead,
+    returned separately so the estimate CLI can show it is noise next to the
+    pool. The shared sizing formula for ``accelerate-tpu estimate-memory``'s
+    ``+kv (serve)`` column."""
+    seq = max_seq_len if max_seq_len is not None else config.max_seq_len
+    pages_per_seq = -(-seq // page_size)
+    if num_pages is None:
+        num_pages = batch * pages_per_seq + 1
+    pool = int(
+        2 * config.num_layers * config.kv_heads * config.dim_per_head
+        * num_pages * page_size * dtype_bytes
+    )
+    table = int(batch * pages_per_seq * 4)
+    return pool, table
 
 
 class SlotAllocator:

@@ -40,6 +40,53 @@ def make_prompts(
     return [rng.integers(0, vocab_size, (int(s),)).astype(np.int32) for s in lens]
 
 
+def make_mixed_prompts(
+    n: int,
+    vocab_size: int,
+    min_len: int,
+    max_len: int,
+    long_fraction: float = 0.1,
+    long_multiplier: int = 8,
+    shared_prefix: int = 0,
+    seed: int = 0,
+) -> list[np.ndarray]:
+    """The ROADMAP's gating trace: mostly-short traffic with a long-prompt
+    tail, optionally behind a fleet-wide system prompt.
+
+    ``long_fraction`` of the prompts stretch to ``long_multiplier``–
+    ``2×long_multiplier`` times the median short length — the arrival that
+    stalls every admitted request's decode behind a monolithic prefill, and
+    exactly what chunked prefill (``prefill_chunk``) exists to absorb.
+    ``shared_prefix`` prepends the SAME ``shared_prefix`` tokens to every
+    prompt (one deterministic system prompt per seed), so a paged engine
+    with prefix sharing prefills it once and every later request forks its
+    pages; the dense engine re-prefills it per request. Long positions are
+    interleaved deterministically across the trace (not clustered at the
+    end) so a sweep at any offered rate meets the long tail mid-stream."""
+    if not 0.0 <= long_fraction <= 1.0:
+        raise ValueError(f"long_fraction must be in [0, 1], got {long_fraction}")
+    rng = np.random.default_rng(seed)
+    median = (min_len + max_len) // 2
+    prompts: list[np.ndarray] = []
+    n_long = int(round(n * long_fraction))
+    # spread long arrivals evenly through the trace: a long prompt mid-burst
+    # is the TTFT-spike scenario, a trailing cluster is not
+    long_at = set(np.linspace(0, n - 1, n_long, dtype=int).tolist()) if n_long else set()
+    prefix = (
+        rng.integers(0, vocab_size, (shared_prefix,)).astype(np.int32)
+        if shared_prefix > 0
+        else None
+    )
+    for i in range(n):
+        if i in long_at:
+            s = int(rng.integers(long_multiplier * median, 2 * long_multiplier * median + 1))
+        else:
+            s = int(rng.integers(min_len, max_len + 1))
+        body = rng.integers(0, vocab_size, (s,)).astype(np.int32)
+        prompts.append(body if prefix is None else np.concatenate([prefix, body]))
+    return prompts
+
+
 def run_offered_load(
     engine,
     prompts: Sequence[np.ndarray],
@@ -98,4 +145,4 @@ def run_offered_load(
     return out
 
 
-__all__ = ["make_prompts", "run_offered_load"]
+__all__ = ["make_mixed_prompts", "make_prompts", "run_offered_load"]

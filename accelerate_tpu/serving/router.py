@@ -455,11 +455,39 @@ class ServingRouter:
         """Seam for live-KV migration between replicas. A request's cache
         slice is an array-redistribution problem (arXiv:2112.01075 — relayout
         through portable collectives without materializing the full buffer);
-        until that lands, this returns False and failover re-prefills from
-        the prompt, which is correct by construction. The signature is the
-        contract: src may already be unreachable for anything but its device
-        buffers, and a False here must always leave re-prefill as the path."""
+        the paged engine now gives the problem its concrete source
+        description — :meth:`~.engine.ServingEngine.kv_page_layout` names
+        exactly which physical pages hold the request's live KV, in what
+        order, with how many valid positions — so the transfer is a gather of
+        ``len(pages)`` fixed-shape blocks, not a relayout of a ``max_len``
+        slab. The relayout itself has not landed: this returns False and
+        failover re-prefills from the prompt, which is correct by
+        construction. The signature is the contract: src may already be
+        unreachable for anything but its device buffers, and a False here
+        must always leave re-prefill as the path."""
+        layout = self.kv_handoff_layout(src, rr)
+        if layout is None:
+            return False  # nothing readable to relay: re-prefill is the path
+        # the source side of the 2112.01075 transfer is fully described;
+        # record it so the seam's readiness is observable, then fall back
+        self._fleet_record(
+            {"event": "kv_handoff_available", "request_id": rr.id,
+             "src": src.index, "dst": dst.index, "pages": len(layout["pages"]),
+             "page_size": layout["page_size"], "length": layout["length"]}
+        )
         return False
+
+    def kv_handoff_layout(self, src: EngineReplica, rr: RoutedRequest) -> Optional[dict]:
+        """The page-granular source description a handoff would relay: the
+        engine's :meth:`~.engine.ServingEngine.kv_page_layout` for ``rr``,
+        guarded by the fleet's reachability rules (a DEAD replica's memory is
+        gone — SIGKILL semantics — so only a live source is readable)."""
+        if not src.alive:
+            return None
+        try:
+            return src.engine.kv_page_layout(rr.id)
+        except Exception:  # noqa: BLE001 - a half-dead source must not break re-home
+            return None
 
     # -- lifecycle operations ------------------------------------------------
 

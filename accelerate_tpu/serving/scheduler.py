@@ -18,6 +18,17 @@ next ``step()``. A rejected ``submit`` raises :class:`QueueFull` carrying
 the queue depth and a ``retry_after_s`` hint so clients can shed load
 intelligently instead of hammering. ``requeue_front`` puts a request whose
 slot went bad back at the head of the line.
+
+Paged KV (serving/paging.py): admission is gated on free PAGES, not free
+slots — ``admit_ready``'s ``free_slot`` callback is the paged cache's
+admission path, which returns None when the page pool (after prefix-cache
+eviction) cannot cover the request's first prefill span, so the request
+waits exactly like slot contention. ``preempt_slot`` is the
+page-pressure hook: when a growing request needs a page and the pool is
+dry, the engine evicts a strictly YOUNGER request back to the queue head
+(youngest first; the grower yields to its elders when it is itself the
+youngest), so the oldest request always progresses — recompute-style
+preemption that can neither deadlock nor livelock.
 """
 
 from __future__ import annotations
@@ -70,6 +81,12 @@ class Request:
     generated: list[int] = field(default_factory=list)
     cancelled: bool = False
     requeues: int = 0  # times a bad slot sent this request back to the queue
+    preemptions: int = 0  # times page pressure evicted this request (paged KV)
+    # paged-prefill progress: tokens of prompt[:-1] already in cache pages
+    # (starts at the shared-prefix hit, advances per chunk; == prefill length
+    # once the slot is decode-visible)
+    prefilled: int = 0
+    prefix_hit: int = 0  # tokens reused from the prefix cache at admission
 
     @property
     def deadline_at(self) -> Optional[float]:
@@ -169,6 +186,22 @@ class ContinuousBatchingScheduler:
         the queue (it already waited its turn) for a fresh admission — used
         when the slot is quarantined. Generated tokens are discarded: the
         slot's cache is suspect, so the request restarts from its prompt."""
+        request = self._pull_to_front(slot)
+        request.requeues += 1
+        return request
+
+    def preempt_slot(self, slot: int) -> Request:
+        """Page pressure evicted this request: back to the HEAD of the queue
+        for a restart (recompute-style preemption — its pages are freed, and
+        re-prefill regenerates them bit-identically at temperature 0).
+        Counted separately from ``requeues``: preemption is a resource
+        decision, not evidence the request poisons slots, so it never burns
+        the ``max_request_requeues`` budget."""
+        request = self._pull_to_front(slot)
+        request.preemptions += 1
+        return request
+
+    def _pull_to_front(self, slot: int) -> Request:
         request = self.slots[slot]
         if request is None:
             raise ValueError(f"slot {slot} holds no request")
@@ -176,7 +209,8 @@ class ContinuousBatchingScheduler:
         request.slot = None
         request.generated = []
         request.first_token_at = None  # TTFT restarts honestly: no trusted token yet
-        request.requeues += 1
+        request.prefilled = 0  # the cache pages are gone; prefill restarts too
+        request.prefix_hit = 0
         self.queue.appendleft(request)
         return request
 

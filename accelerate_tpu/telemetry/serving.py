@@ -29,10 +29,17 @@ def _percentiles_ms(samples: list[float], prefix: str, qs=(50, 90, 99)) -> dict:
 
 
 class ServingStats:
-    """Accumulates engine-step and request-lifecycle samples."""
+    """Accumulates engine-step and request-lifecycle samples.
 
-    def __init__(self, num_slots: int):
+    ``num_pages``/``page_size`` are set by a paged engine (serving/paging.py)
+    and unlock the page-economy metrics: page occupancy, peak pages in use
+    (the honest "what pool would this traffic have needed" number), prefix
+    hit rate, chunked-prefill and preemption counters."""
+
+    def __init__(self, num_slots: int, num_pages: Optional[int] = None, page_size: Optional[int] = None):
         self.num_slots = num_slots
+        self.num_pages = num_pages
+        self.page_size = page_size
         self.started_at = time.perf_counter()
         self.first_decode_at: Optional[float] = None
         self.steps = 0
@@ -58,6 +65,18 @@ class ServingStats:
         self.slot_quarantines = 0
         self.slot_quarantine_releases = 0
         self.watchdog_trips = 0
+        # paged-KV counters (serving/paging.py): zero/irrelevant on the dense
+        # slot layout, summed normally by the fleet rollup either way
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
+        self.prefill_chunks = 0
+        self.requests_preempted = 0
+        self.cow_page_copies = 0
+        self.page_pressure_events = 0
+        self.page_occupancy_sum = 0.0
+        self.peak_pages_in_use = 0
+        self.last_pages_in_use = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -94,12 +113,37 @@ class ServingStats:
     def record_prefill(self, bucket: int) -> None:
         self.prefill_tokens += bucket
 
+    def record_prefill_chunk(self) -> None:
+        self.prefill_chunks += 1
+
+    def record_prefix_hit(self, tokens_reused: int) -> None:
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += tokens_reused
+
+    def record_prefix_miss(self) -> None:
+        self.prefix_misses += 1
+
+    def record_preempted(self) -> None:
+        self.requests_preempted += 1
+
+    def record_cow_copy(self) -> None:
+        self.cow_page_copies += 1
+
+    def record_page_pressure(self) -> None:
+        self.page_pressure_events += 1
+
     def record_step(
-        self, duration_s: float, active: int, waiting: int, tokens: Optional[int] = None
+        self,
+        duration_s: float,
+        active: int,
+        waiting: int,
+        tokens: Optional[int] = None,
+        pages_in_use: Optional[int] = None,
     ) -> None:
         """``tokens`` = tokens actually delivered this step (defaults to
         ``active``; the engine passes fewer when a quarantined slot's token
-        was discarded — throughput must never count undelivered tokens)."""
+        was discarded — throughput must never count undelivered tokens).
+        ``pages_in_use`` feeds the paged-pool economy metrics."""
         if self.first_decode_at is None:
             self.first_decode_at = time.perf_counter() - duration_s
         self.steps += 1
@@ -109,6 +153,10 @@ class ServingStats:
         self.occupancy_sum += active / self.num_slots
         self.queue_depth_sum += waiting
         self.max_active = max(self.max_active, active)
+        if pages_in_use is not None and self.num_pages:
+            self.last_pages_in_use = pages_in_use
+            self.peak_pages_in_use = max(self.peak_pages_in_use, pages_in_use)
+            self.page_occupancy_sum += pages_in_use / max(self.num_pages - 1, 1)
 
     def record_first_token(self, ttft_s: float) -> None:
         self.ttft_seconds.append(ttft_s)
@@ -159,6 +207,24 @@ class ServingStats:
         if self.steps:
             out["queue_depth_mean"] = round(self.queue_depth_sum / self.steps, 3)
             out["decode_seconds"] = round(self.decode_seconds, 4)
+        if self.num_pages:
+            out["num_pages"] = self.num_pages
+            out["page_size"] = self.page_size
+            out["pages_in_use"] = self.last_pages_in_use
+            out["peak_pages_in_use"] = self.peak_pages_in_use
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_misses"] = self.prefix_misses
+            out["prefix_tokens_reused"] = self.prefix_tokens_reused
+            looked_up = self.prefix_hits + self.prefix_misses
+            out["prefix_hit_rate"] = (
+                round(self.prefix_hits / looked_up, 4) if looked_up else 0.0
+            )
+            out["prefill_chunks"] = self.prefill_chunks
+            out["requests_preempted"] = self.requests_preempted
+            out["cow_page_copies"] = self.cow_page_copies
+            out["page_pressure_events"] = self.page_pressure_events
+            if self.steps:
+                out["page_occupancy"] = round(self.page_occupancy_sum / self.steps, 4)
         out.update(_percentiles_ms(self.step_seconds, "per_token"))
         out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
         out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
@@ -184,11 +250,22 @@ def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
         "requests_completed", "requests_rejected", "requests_expired",
         "requests_cancelled", "requests_requeued", "requests_failed",
         "requests_rehomed", "slot_quarantines", "slot_quarantine_releases",
-        "watchdog_trips",
+        "watchdog_trips", "prefix_hits", "prefix_misses",
+        "prefix_tokens_reused", "prefill_chunks", "requests_preempted",
+        "cow_page_copies", "page_pressure_events",
     )
     for key in counters:
         out[key] = sum(getattr(s, key) for s in stats_list)
     out["num_slots"] = sum(s.num_slots for s in stats_list)
+    paged = [s for s in stats_list if s.num_pages]
+    if paged:
+        # pools are per-replica HBM: capacity and peaks ADD across the fleet
+        out["num_pages"] = sum(s.num_pages for s in paged)
+        out["peak_pages_in_use"] = sum(s.peak_pages_in_use for s in paged)
+        looked_up = out["prefix_hits"] + out["prefix_misses"]
+        out["prefix_hit_rate"] = (
+            round(out["prefix_hits"] / looked_up, 4) if looked_up else 0.0
+        )
     out["max_active_slots"] = sum(s.max_active for s in stats_list)
     elapsed = max(s.elapsed_seconds for s in stats_list)
     out["throughput_tokens_per_sec"] = (

@@ -794,19 +794,36 @@ def bench_serving() -> dict:
     model instance: the jit cache lives on the model, so only the warmup
     point compiles and every later point's own compile count must be 0 —
     ``serving_steady_state_compile_count`` pins the engine's core invariant
-    in the BENCH json."""
+    in the BENCH json.
+
+    Default workload sizes are calibrated to the CPU CI container (~3-5
+    generated tok/s at 125M): the section now runs NINE engine/fleet points
+    (sweep + paged economy + shared prefix + mixed chunked/monolithic +
+    fleet healthy/drill), so each point is kept to a few hundred generated
+    tokens — enough for stable percentiles and every paged claim, small
+    enough that the whole section lands in minutes, not hours. The env
+    knobs scale everything back up on real accelerators."""
+    import sys
+
     import jax
     import jax.numpy as jnp
 
     from accelerate_tpu.models import build_model
     from accelerate_tpu.serving import ServingEngine, make_prompts, run_offered_load
 
+    t0 = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        # stderr stage log: stdout stays the single JSON line; a timeout or
+        # hang names the slow point instead of dying silently
+        print(f"[serving +{time.perf_counter() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
     _reset_state()
     name = os.environ.get("BENCH_SERVING_MODEL", "llama-125m")
     num_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
     max_len = int(os.environ.get("BENCH_SERVING_MAX_LEN", "512"))
-    max_new = int(os.environ.get("BENCH_SERVING_MAX_NEW", "64"))
-    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "32"))
+    max_new = int(os.environ.get("BENCH_SERVING_MAX_NEW", "32"))
+    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "16"))
 
     model = build_model(name)
     params = model.init(jax.random.key(0))
@@ -826,9 +843,14 @@ def bench_serving() -> dict:
     warm_engine = engine()
     warm_engine.warmup()
     warm = warm_engine.metrics()
+    _stage("warmup done")
     rates = [float(r) for r in os.environ.get("BENCH_SERVING_RATES", "4,16").split(",") if r]
-    sweep = [run_offered_load(engine(), prompts, max_new, offered_rps=r) for r in rates]
+    sweep = []
+    for r in rates:
+        sweep.append(run_offered_load(engine(), prompts, max_new, offered_rps=r))
+        _stage(f"offered-load point {r} req/s done")
     saturated = run_offered_load(engine(), prompts, max_new, float("inf"))
+    _stage("saturation point done")
     sweep.append(saturated)
 
     result = {
@@ -857,6 +879,121 @@ def bench_serving() -> dict:
         result[f"serving_ttft_p{q}_ms"] = saturated.get(f"ttft_p{q}_ms")
         result[f"serving_per_token_p{q}_ms"] = saturated.get(f"per_token_p{q}_ms")
 
+    # -- paged KV economy: HBM bytes/request vs the dense slab ---------------
+    # The engine defaults to the paged pool (serving/paging.py), so the sweep
+    # above already measured it; what the json must RECORD is the memory
+    # claim. Dense, every request reserves one slot's full max_len slab
+    # whatever its length; paged, the pool's peak page watermark over the
+    # run prices what the traffic actually held — per request, that is
+    # peak_pages × page_bytes / peak concurrency.
+    from accelerate_tpu.serving import kv_cache_bytes, paged_kv_cache_bytes
+
+    page_size = saturated.get("page_size") or 16
+    pool_bytes, _ = paged_kv_cache_bytes(
+        model.config, num_slots, max_len, page_size=page_size
+    )
+    page_bytes = pool_bytes // (saturated.get("num_pages") or 1)
+    dense_per_req = kv_cache_bytes(model.config, 1, max_len)
+    peak_pages = saturated.get("peak_pages_in_use") or 0
+    peak_active = max(saturated.get("max_active_slots") or 1, 1)
+    paged_per_req = int(peak_pages * page_bytes / peak_active)
+    result.update(
+        {
+            "serving_page_size": page_size,
+            "serving_dense_hbm_bytes_per_req": dense_per_req,
+            "serving_paged_hbm_bytes_per_req": paged_per_req,
+            "serving_paged_hbm_reduction_pct": (
+                round(100.0 * (1.0 - paged_per_req / dense_per_req), 2)
+                if dense_per_req
+                else None
+            ),
+            "serving_page_occupancy": saturated.get("page_occupancy"),
+        }
+    )
+
+    # -- prefix sharing: the shared-system-prompt scenario -------------------
+    # Every request carries the same leading system prompt; the paged engine
+    # prefills it once and COW-forks its pages, so the recorded hit rate must
+    # be > 0 (first arrival misses and registers, the rest hit).
+    from accelerate_tpu.serving import make_mixed_prompts
+
+    shared_len = int(os.environ.get("BENCH_SERVING_SHARED_PREFIX", "64"))
+    shared_prompts = make_mixed_prompts(
+        n_requests, model.config.vocab_size, p_min, p_max,
+        long_fraction=0.0, shared_prefix=shared_len, seed=1,
+    )
+    shared_run = run_offered_load(engine(), shared_prompts, max_new, float("inf"))
+    _stage("shared-prefix point done")
+    result.update(
+        {
+            "serving_shared_prefix_len": shared_len,
+            "serving_prefix_hit_rate": shared_run.get("prefix_hit_rate"),
+            "serving_prefix_tokens_reused": shared_run.get("prefix_tokens_reused"),
+            "serving_shared_prefix_compile_count": shared_run["compile_count"],
+        }
+    )
+
+    # -- mixed long/short sweep: chunked prefill on/off ----------------------
+    # The ROADMAP gating scenario: ~10% of prompts at 8–16× the median
+    # length. The number that matters is the TTFT p99 of the SHORT requests
+    # — a monolithic long prefill stalls every step behind one huge program
+    # call, chunked prefill interleaves it into the decode cadence. (The
+    # long prompts' own TTFT legitimately grows with chunking; recording the
+    # overall p99 would let 3 long requests mask the improvement for the
+    # other 29.)
+    mixed_min = int(os.environ.get("BENCH_SERVING_MIXED_MIN", "8"))
+    mixed_max = int(os.environ.get("BENCH_SERVING_MIXED_MAX", "48"))
+    chunk = int(os.environ.get("BENCH_SERVING_PREFILL_CHUNK", "64"))
+    mixed_prompts = make_mixed_prompts(
+        n_requests, model.config.vocab_size, mixed_min, mixed_max,
+        long_fraction=0.1, long_multiplier=8, seed=2,
+    )
+    longest = max(p.size for p in mixed_prompts)
+    mixed_len = max(max_len, longest + max_new)
+
+    def mixed_point(prefill_chunk):
+        eng = ServingEngine(
+            model, params, num_slots=num_slots, max_len=mixed_len,
+            prefill_chunk=prefill_chunk,
+        )
+        ids = [eng.submit(p, max_new) for p in mixed_prompts]
+        res = eng.run()
+        short_ttfts = sorted(
+            res[rid].ttft_s
+            for rid, p in zip(ids, mixed_prompts)
+            if p.size <= mixed_max and res[rid].ttft_s is not None
+        )
+        p99 = short_ttfts[min(int(0.99 * len(short_ttfts)), len(short_ttfts) - 1)]
+        out = eng.metrics()
+        out["short_ttft_p99_ms"] = round(p99 * 1e3, 3)
+        return out
+
+    mono = mixed_point(None)
+    _stage("mixed monolithic point done")
+    chunked = mixed_point(chunk)
+    _stage("mixed chunked point done")
+    result.update(
+        {
+            "serving_mixed_requests": n_requests,
+            "serving_mixed_long_fraction": 0.1,
+            "serving_mixed_max_len": mixed_len,
+            "serving_prefill_chunk": chunk,
+            "serving_mixed_ttft_p99_ms_monolithic": mono["short_ttft_p99_ms"],
+            "serving_mixed_ttft_p99_ms_chunked": chunked["short_ttft_p99_ms"],
+            "serving_mixed_chunked_ttft_improvement_pct": (
+                round(
+                    100.0
+                    * (1.0 - chunked["short_ttft_p99_ms"] / mono["short_ttft_p99_ms"]),
+                    2,
+                )
+                if mono["short_ttft_p99_ms"]
+                else None
+            ),
+            "serving_mixed_prefill_chunks": chunked.get("prefill_chunks"),
+            "serving_mixed_compile_count_chunked": chunked["compile_count"],
+        }
+    )
+
     # -- fleet: routed replicas + the replica-loss drill (fleet_ metrics) ----
     # The same offered load through a health-aware router over N replicas,
     # then again with FaultPlan SIGKILLing one replica mid-stream. Goodput
@@ -877,9 +1014,11 @@ def bench_serving() -> dict:
         )
 
     healthy = run_offered_load(router(), prompts, max_new, float("inf"))
+    _stage("fleet healthy point done")
     plan = FaultPlan(replica_kill_step=kill_step, replica_kill_index=replicas - 1)
     drilled = router(plan)
     drill = run_offered_load(drilled, prompts, max_new, float("inf"))
+    _stage("fleet drill point done")
     baseline_tok_s = saturated["throughput_tokens_per_sec"]
     result.update(
         {

@@ -152,9 +152,13 @@ def test_routed_fleet_zero_steady_state_recompiles(llama):
     tracker = CompileTracker().start()
     router.warmup()
     warm = tracker.snapshot()
-    # ONE replica's worth of programs: decode + 3 × (prefill, insert). The
-    # second replica's warmup hit the shared cache for all 7.
-    assert warm["jit_cache_misses"] == 7
+    # ONE replica's worth of programs: decode + one prefill per bucket (the
+    # paged engine scatters prefill pages directly — no insert programs; a
+    # dense engine would add one insert per bucket). The second replica's
+    # warmup hit the shared cache for every one of them.
+    engine = router.replicas[0].engine
+    per_bucket = 1 if engine.paged else 2
+    assert warm["jit_cache_misses"] == 1 + per_bucket * len(engine.buckets)
     router.generate_many(_prompts([3, 9, 20, 31, 6, 14], seed=4), max_new_tokens=4)
     steady = tracker.snapshot()
     tracker.stop()
@@ -400,7 +404,9 @@ def test_cancel_landing_mid_step_wins_over_same_step_retirement(llama):
     rid = engine.submit(_prompts([4], seed=16)[0], max_new_tokens=2)
     engine.step()  # admit + token 1; next step would retire on length
 
-    real = engine._decode_program
+    # hook whichever decode program the engine's layout actually runs
+    attr = "_paged_decode_program" if engine.paged else "_decode_program"
+    real = getattr(engine, attr)
     acked = []
 
     def hooked():
@@ -413,9 +419,9 @@ def test_cancel_landing_mid_step_wins_over_same_step_retirement(llama):
 
         return wrapper
 
-    engine._decode_program = hooked
+    setattr(engine, attr, hooked)
     results = {r.request_id: r for r in engine.step()}
-    engine._decode_program = real
+    setattr(engine, attr, real)
     assert acked == [True]
     assert results[rid].finish_reason == "cancelled"
     assert engine.stats.requests_cancelled == 1

@@ -46,6 +46,26 @@ def _prompts(lengths, vocab=1024, seed=0):
     return [rng.integers(0, vocab, (s,)).astype(np.int32) for s in lengths]
 
 
+def _poison_slot_kv(engine, slot):
+    """NaN one slot's live K storage, wherever the layout keeps it: the
+    slot's batch index on the dense slab, the slot's physical pages when
+    paged (index 1 of a paged pool is a PAGE, not a slot — and page 0 is the
+    shared null page, which must stay finite)."""
+    if engine.paged:
+        pages = np.asarray(engine.cache.pages_of(slot), np.int32)
+        engine.cache.k = engine.cache.k.at[:, pages].set(jnp.nan)
+    else:
+        engine.cache.k = engine.cache.k.at[:, slot].set(jnp.nan)
+
+
+def _warm_program_count(engine):
+    """Programs a fully-warmed engine holds: one decode step, plus one
+    prefill program per bucket — and on the dense layout a separate insert
+    program per bucket (paged prefill scatters into the pool directly)."""
+    per_bucket = 1 if engine.paged else 2
+    return 1 + per_bucket * len(engine.buckets)
+
+
 # -- slot allocator -----------------------------------------------------------
 
 
@@ -110,17 +130,17 @@ def test_generate_many_matches_generate_gpt2(gpt2):
 
 
 def test_zero_steady_state_recompiles(llama):
-    """After warmup (one prefill+insert program per bucket + one decode
-    program), streaming requests with >= 4 distinct prompt lengths must
-    compile NOTHING and miss the jit cache NEVER."""
+    """After warmup (one prefill program per bucket — plus an insert program
+    each on the dense layout — and one decode program), streaming requests
+    with >= 4 distinct prompt lengths must compile NOTHING and miss the jit
+    cache NEVER."""
     _, params = llama
     model = Llama("llama-tiny")  # fresh instance: clean jit cache, order-independent counts
     engine = ServingEngine(model, params, num_slots=4, max_len=64, buckets=(8, 16, 32))
     tracker = CompileTracker().start()
     engine.generate_many(_prompts([5, 13, 30], seed=3), max_new_tokens=3)  # warm every bucket
     warm = tracker.snapshot()
-    # decode + 3 × (prefill, insert) = 7 programs, one warmup miss each
-    assert warm["jit_cache_misses"] == 7
+    assert warm["jit_cache_misses"] == _warm_program_count(engine)
 
     for prompt in _prompts([3, 7, 9, 14, 17, 25, 31, 6, 12, 28], seed=4):
         engine.submit(prompt, max_new_tokens=8)
@@ -275,8 +295,8 @@ def test_quarantine_requeue_and_probe_release(llama):
     engine = ServingEngine(model, params, num_slots=1, max_len=32)
     rid = engine.submit(prompt, max_new_tokens=4)
     engine.step()  # admit + first decode (healthy)
-    # poison the slot's whole K cache: next decode's logits go non-finite
-    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    # poison the slot's live K storage: next decode's logits go non-finite
+    _poison_slot_kv(engine, 0)
     results = engine.run()
     assert engine.stats.slot_quarantines == 1
     assert engine.stats.requests_requeued == 1
@@ -300,7 +320,7 @@ def test_quarantined_slot_never_serves_until_probe_passes(llama):
     engine = ServingEngine(model, params, num_slots=1, max_len=32)
     engine.submit(_prompts([4], seed=28)[0], max_new_tokens=2)
     engine.step()
-    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    _poison_slot_kv(engine, 0)
     engine.step()  # quarantine fires; request back at queue head
     assert engine.cache.quarantined == frozenset({0})
     assert engine.scheduler.waiting == 1
@@ -324,7 +344,7 @@ def test_request_fails_after_max_requeues_instead_of_livelocking(llama):
     engine.step()
     # simulate a request already bounced through bad slots up to the cap
     engine.scheduler.slots[0].requeues = engine.max_request_requeues
-    engine.cache.k = engine.cache.k.at[:, 0].set(jnp.nan)
+    _poison_slot_kv(engine, 0)
     results = engine.run()
     assert results[rid].finish_reason == "failed"
     assert engine.stats.requests_failed == 1
@@ -463,15 +483,16 @@ def test_run_offered_load_backpressure_counts_in_ttft(llama):
 
 
 def test_engine_warmup_compiles_every_bucket(llama):
-    """warmup() deterministically compiles one (prefill, insert) pair per
-    bucket + the decode step; any traffic mix afterwards compiles nothing."""
+    """warmup() deterministically compiles one prefill program per bucket
+    (plus a dense layout's insert pair) + the decode step; any traffic mix
+    afterwards compiles nothing."""
     _, params = llama
     model = Llama("llama-tiny")  # fresh jit cache
     engine = ServingEngine(model, params, num_slots=2, max_len=64, buckets=(8, 16, 32))
     tracker = CompileTracker().start()
     engine.warmup()
     warm = tracker.snapshot()
-    assert warm["jit_cache_misses"] == 7  # decode + 3 × (prefill, insert)
+    assert warm["jit_cache_misses"] == _warm_program_count(engine)
     engine.generate_many(_prompts([3, 9, 20, 31], seed=13), max_new_tokens=4)
     steady = tracker.snapshot()
     tracker.stop()
