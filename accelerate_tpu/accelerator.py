@@ -973,6 +973,7 @@ class Accelerator:
         compile: bool = True,
         label: str = "compiled_step",
         write_record: bool = True,
+        contracts_dir: Optional[str] = None,
         **audit_kwargs,
     ):
         """Audit the fused step program (docs/analysis.md).
@@ -987,8 +988,11 @@ class Accelerator:
         ``{"kind": "analysis"}`` record in ``telemetry.jsonl``.
 
         ``compile=True`` (default) compiles a second AOT executable so the
-        post-GSPMD properties (real collectives, executable alias table) are
-        audited — costs one extra XLA compile of the step.
+        post-GSPMD properties (real collectives, executable alias table,
+        memory + schedule passes) are audited — costs one extra XLA compile
+        of the step. ``contracts_dir`` additionally checks the report against
+        the program's checked-in contract (``<contracts_dir>/<label>.json``)
+        and appends any ``CONTRACT_DRIFT`` findings — the differential gate.
         """
         from .analysis import audit_lowered
 
@@ -1010,6 +1014,10 @@ class Accelerator:
             sharded_intent=audit_kwargs.pop("sharded_intent", self._sharding_intent()),
             **audit_kwargs,
         )
+        if contracts_dir is not None:
+            from .analysis.contracts import gate_reports
+
+            gate_reports([report], contracts_dir)
         if write_record and self.telemetry.enabled:
             self.telemetry.write_record("analysis", {"analysis": report.to_dict()})
         return report
@@ -1024,6 +1032,7 @@ class Accelerator:
         model: Optional[PreparedModel] = None,
         clip_grad_norm: Optional[float] = None,
         clip_grad_value: Optional[float] = None,
+        donate: bool = True,
     ):
         """One fused jit program: grads (+ scan over microbatches) → clip → update.
 
@@ -1032,6 +1041,11 @@ class Accelerator:
         ``lax.scan`` — no eager Python between microbatches, buffers donated.
         This is what the reference's whole hot loop (SURVEY §3.3) compiles down
         to, and the path benchmarks should use.
+
+        ``donate=False`` keeps params/opt_state undonated — for debugging
+        against the pre-step state, and for the analyzer's seeded
+        dropped-donation regression (tests/test_contracts.py), at the cost of
+        a second resident copy of the whole training state.
         """
         if model is None:
             model = self._models[-1]
@@ -1171,7 +1185,10 @@ class Accelerator:
             opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
             return params, opt_state, loss, scale, growth_tracker, skipped, gstate
 
-        jitted = jax.jit(guarded_step_impl if res_on else step_impl, donate_argnums=(0, 1))
+        donate_argnums = (0, 1) if donate else ()
+        jitted = jax.jit(
+            guarded_step_impl if res_on else step_impl, donate_argnums=donate_argnums
+        )
 
         def lower(batch):
             """AOT-lower the fused program against the LIVE params/opt_state —
@@ -1246,7 +1263,7 @@ class Accelerator:
         # program.py audits the jitted fn via lower(); tests pin donation)
         step.jitted = jitted
         step.lower = lower
-        step.donate_argnums = (0, 1)
+        step.donate_argnums = donate_argnums
         return step
 
     # ------------------------------------------------------------------

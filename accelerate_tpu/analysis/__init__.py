@@ -1,11 +1,21 @@
 """Static analysis for compiled step and decode programs.
 
-Three passes over three layers of the stack, one report shape:
+Six passes over the layers of the stack, one report shape:
 
 - :mod:`.program` — jaxpr/HLO audit of a ``jax.stages.Lowered``/``Compiled``
   program: donation aliasing, fp64 leaks, baked-in constants, the collective
   inventory, and sharding-resolved-to-replication. Reached via
   ``Accelerator.analyze()`` / ``ServingEngine.analyze()``.
+- :mod:`.memory` — HBM audit over the executable's buffer assignment:
+  argument/output/temp/alias bytes, a peak-HBM estimate, bytes saved by
+  donation, ``TEMP_BLOWUP``/``HBM_OVER_BUDGET`` findings.
+- :mod:`.schedule` — collective-overlap pass over post-SPMD HLO: pairs
+  async start/done collectives, classifies each as overlapped-with-compute
+  vs serialized, and prices the serialized-comm bytes on the critical path.
+- :mod:`.contracts` — per-program checked-in expectations
+  (``tests/contracts/*.json``) turning the audits into a differential
+  regression gate: ``CONTRACT_DRIFT`` names exactly which expectation moved
+  and by how much.
 - :mod:`.sanitizer` — runtime hazard watcher for warm-loop windows: implicit
   device→host syncs, steady-state recompiles (with ``explain_recompile``
   signature diffs), jit-cache misses.
@@ -17,8 +27,16 @@ CLI: ``accelerate-tpu analyze`` (commands/analyze.py). Findings catalog:
 docs/analysis.md.
 """
 
+from .contracts import (
+    ProgramContract,
+    default_contracts_dir,
+    drift_count,
+    gate_reports,
+    update_contract,
+)
 from .findings import CATALOG, ERROR, INFO, WARNING, AnalysisReport, Finding
 from .lint import lint_file, lint_paths, lint_source
+from .memory import memory_audit, memory_summary
 from .program import (
     audit_lowered,
     collective_inventory,
@@ -30,6 +48,7 @@ from .program import (
     replication_audit,
 )
 from .sanitizer import HazardSanitizer, explain_recompile, signature_of
+from .schedule import collective_schedule, schedule_audit
 
 __all__ = [
     "CATALOG",
@@ -39,17 +58,26 @@ __all__ = [
     "AnalysisReport",
     "Finding",
     "HazardSanitizer",
+    "ProgramContract",
     "audit_lowered",
     "collective_inventory",
+    "collective_schedule",
     "constant_audit",
+    "default_contracts_dir",
     "donation_audit",
     "donation_drop_warning",
+    "drift_count",
     "dtype_audit",
     "explain_recompile",
     "flatten_args_info",
+    "gate_reports",
     "lint_file",
     "lint_paths",
     "lint_source",
+    "memory_audit",
+    "memory_summary",
     "replication_audit",
+    "schedule_audit",
     "signature_of",
+    "update_contract",
 ]

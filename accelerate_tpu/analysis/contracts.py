@@ -1,0 +1,482 @@
+"""Program contracts: checked-in expectations that make the analyzer a
+*differential* gate.
+
+PR 5's audit measures a compiled program once; nothing stopped the next
+change from silently regressing what it measured — one extra all-gather, a
+dropped donation alias, a temp-buffer blowup all pass a point-in-time audit
+that only asks "zero errors?". A :class:`ProgramContract` pins the measured
+properties of one program as a JSON file under ``tests/contracts/``:
+
+.. code-block:: json
+
+    {
+      "program": "bert_tiny_step",
+      "version": 1,
+      "tolerance_pct": 25.0,
+      "env": {"backend": "cpu", "num_devices": 8},
+      "expectations": {
+        "max_errors": 0,
+        "collectives": {"all_reduce": {"count": 26, "bytes": 1394700}},
+        "donation": {"declared": 76, "aliased": 76},
+        "memory": {"peak_hbm_bytes": 14313861, "temp_bytes": 7577960},
+        "schedule": {"serialized_comm_bytes": 1394700, "overlapped_count": 0},
+        "compile_seconds_budget": 24.0
+      }
+    }
+
+``check(report)`` compares a live :class:`~.findings.AnalysisReport` against
+the contract and emits one ``CONTRACT_DRIFT`` (error) per moved expectation,
+naming the field, both values, and the delta. **Counts are exact** (a new
+collective is a new collective); **byte fields carry a tolerance**
+(``tolerance_pct``, scaled up by callers on backends whose lowering differs
+from the recording environment); ``compile_seconds_budget`` is a ceiling
+only. Drift is symmetric for counts and byte expectations — an *improvement*
+also fails the gate until the contract is updated, which is the point: the
+expectation moves in a reviewed diff (``--update-contracts``), never
+silently.
+
+Contracts pin the environment they were recorded on (backend + device
+count): collective counts are functions of both, so a mismatched environment
+skips with ``CONTRACT_ENV_SKIPPED`` instead of fabricating drift.
+
+``update_contract`` is churn-free: when the existing contract still passes
+against the live report, the file is left byte-identical (tolerances and
+budgets are not re-derived every run), so ``--update-contracts`` twice in a
+row is a no-op — the round-trip the tests pin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .findings import ERROR, WARNING, AnalysisReport, Finding
+
+CONTRACT_VERSION = 1
+DEFAULT_TOLERANCE_PCT = 25.0
+# below this, percentage tolerances on byte fields collapse to nothing and
+# tiny shape jitters (a 512-byte gather) would read as drift
+_BYTE_SLACK_FLOOR = 1024
+# compile budgets leave generous headroom over the recorded wall time: the
+# gate is for order-of-magnitude compile regressions, not machine weather
+_COMPILE_BUDGET_FACTOR = 8.0
+_COMPILE_BUDGET_FLOOR_S = 10.0
+
+
+def default_contracts_dir() -> str:
+    """``tests/contracts`` of the repo this package lives in, falling back to
+    the working directory's ``tests/contracts`` for installed copies."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidate = os.path.join(repo, "tests", "contracts")
+    if os.path.isdir(candidate):
+        return candidate
+    return os.path.join(os.getcwd(), "tests", "contracts")
+
+
+def contract_path(contracts_dir: str, program: str) -> str:
+    return os.path.join(contracts_dir, f"{program}.json")
+
+
+def _is_program_report(report: AnalysisReport) -> bool:
+    """Only compiled/lowered program audits are contractable — lint reports
+    and fleet-merge shells (whose inventory is just sub-program prefixes)
+    have no donation/collective surface of their own."""
+    return bool(report.meta.get("label")) and (
+        "donation" in report.inventory or "collectives" in report.inventory
+    )
+
+
+@dataclass
+class ProgramContract:
+    program: str
+    expectations: dict
+    env: dict = field(default_factory=dict)
+    tolerance_pct: float = DEFAULT_TOLERANCE_PCT
+    version: int = CONTRACT_VERSION
+    # whether the recording audit compiled the program: post-GSPMD sections
+    # (executable collectives, memory, schedule) only exist then, and a
+    # lowered-only report must not read as "all collectives vanished"
+    compiled: bool = True
+
+    # -- construction / persistence ---------------------------------------
+
+    @classmethod
+    def from_report(
+        cls, report: AnalysisReport, tolerance_pct: float = DEFAULT_TOLERANCE_PCT
+    ) -> "ProgramContract":
+        """Pin a live report's measured properties. Only sections the report
+        actually carries are recorded, so a lowered-only audit (prefill
+        spans) yields a contract checkable against lowered-only reports."""
+        inv = report.inventory
+        exp: dict[str, Any] = {"max_errors": 0}
+        if "collectives" in inv:
+            exp["collectives"] = {
+                kind: {"count": int(stats["count"]), "bytes": int(stats["bytes"])}
+                for kind, stats in sorted(inv["collectives"].items())
+            }
+        donation = inv.get("donation")
+        if donation:
+            exp["donation"] = {
+                "declared": int(donation.get("declared", 0)),
+                "aliased": int(donation.get("aliased", 0)),
+            }
+        memory = inv.get("memory")
+        if memory:
+            exp["memory"] = {
+                "peak_hbm_bytes": int(memory.get("peak_hbm_bytes", 0)),
+                "temp_bytes": int(memory.get("temp_bytes", 0)),
+            }
+        schedule = inv.get("schedule")
+        if schedule:
+            exp["schedule"] = {
+                "serialized_comm_bytes": int(schedule.get("serialized_comm_bytes", 0)),
+                "overlapped_count": int(schedule.get("overlapped_count", 0)),
+            }
+        compile_s = report.meta.get("compile_seconds")
+        if compile_s is not None:
+            exp["compile_seconds_budget"] = round(
+                max(_COMPILE_BUDGET_FLOOR_S, float(compile_s) * _COMPILE_BUDGET_FACTOR), 1
+            )
+        env = {
+            "backend": report.meta.get("backend", "unknown"),
+            "num_devices": int(report.meta.get("num_devices", 0)),
+        }
+        return cls(
+            program=report.meta.get("label", "program"),
+            expectations=exp,
+            env=env,
+            tolerance_pct=tolerance_pct,
+            compiled=bool(report.meta.get("compiled", False)),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ProgramContract":
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        return cls(
+            program=payload["program"],
+            expectations=payload["expectations"],
+            env=payload.get("env", {}),
+            tolerance_pct=float(payload.get("tolerance_pct", DEFAULT_TOLERANCE_PCT)),
+            version=int(payload.get("version", CONTRACT_VERSION)),
+            compiled=bool(payload.get("compiled", True)),
+        )
+
+    def to_json(self) -> str:
+        """Deterministic serialization (sorted keys, stable formatting) so an
+        unchanged contract is byte-identical across updates."""
+        payload = {
+            "program": self.program,
+            "version": self.version,
+            "compiled": self.compiled,
+            "tolerance_pct": self.tolerance_pct,
+            "env": self.env,
+            "expectations": self.expectations,
+        }
+        return json.dumps(payload, indent=2, sort_keys=True) + "\n"
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+
+    # -- the check ---------------------------------------------------------
+
+    def _drift(
+        self, findings: list, fieldname: str, expected, actual, unit: str = ""
+    ) -> None:
+        try:
+            delta = actual - expected
+            delta_s = f"{delta:+g}"
+        except TypeError:
+            delta, delta_s = None, "changed"
+        findings.append(
+            Finding(
+                "CONTRACT_DRIFT",
+                f"{self.program}: {fieldname} drifted from its contract: "
+                f"expected {expected}{unit}, got {actual}{unit} ({delta_s}{unit})",
+                path=f"{self.program}:{fieldname}",
+                data={
+                    "program": self.program,
+                    "field": fieldname,
+                    "expected": expected,
+                    "actual": actual,
+                    **({"delta": delta} if delta is not None else {}),
+                },
+            )
+        )
+
+    def check(
+        self, report: AnalysisReport, tolerance_scale: float = 1.0
+    ) -> list[Finding]:
+        """Compare a live report against this contract. Returns the drift
+        findings (empty = the program still matches its expectations)."""
+        report_env = {
+            "backend": report.meta.get("backend", "unknown"),
+            "num_devices": int(report.meta.get("num_devices", 0)),
+        }
+        if self.env and report_env != self.env:
+            return [
+                Finding(
+                    "CONTRACT_ENV_SKIPPED",
+                    f"{self.program}: contract recorded on {self.env}, this "
+                    f"report ran on {report_env} — collective counts are "
+                    "environment functions, skipping",
+                    path=self.program,
+                    data={"contract_env": self.env, "report_env": report_env},
+                )
+            ]
+        findings: list[Finding] = []
+        exp = self.expectations
+        tol_pct = self.tolerance_pct * max(tolerance_scale, 0.0)
+        # compiled and lowered-only audits measure DIFFERENT collective
+        # inventories (post-GSPMD executable vs pre-partitioning StableHLO,
+        # which only names user-written collectives), and memory/schedule
+        # exist only compiled — so any compiled-flag mismatch, in EITHER
+        # direction, skips those sections instead of fabricating mass drift.
+        # Donation and errors are lowering-level and still gate.
+        report_compiled = bool(report.meta.get("compiled", False))
+        degraded = self.compiled != report_compiled
+        if degraded:
+            side = (
+                "this report is lowered-only — rerun without --no-compile"
+                if self.compiled
+                else "this report is compiled — regenerate the contract "
+                "with --update-contracts from a compiled run"
+            )
+            findings.append(
+                Finding(
+                    "CONTRACT_DRIFT",
+                    f"{self.program}: contract recorded "
+                    f"{'compiled' if self.compiled else 'lowered-only'} but "
+                    f"{side}; collectives/memory/schedule/compile budget "
+                    "unchecked",
+                    severity=WARNING,
+                    path=f"{self.program}:compiled",
+                    data={"program": self.program, "field": "compiled"},
+                )
+            )
+
+        def bytes_drift(fieldname: str, expected: int, actual: int) -> None:
+            slack = max(expected * tol_pct / 100.0, _BYTE_SLACK_FLOOR)
+            if abs(actual - expected) > slack:
+                self._drift(findings, fieldname, expected, actual, unit=" bytes")
+
+        # zero-ERROR requirement (contract findings are appended after this
+        # check, so only genuine program findings count here). A merged root
+        # carries its sub-programs' findings too (engine prefill spans, fleet
+        # replicas) — those gate via their OWN contracts; counting them here
+        # would misattribute a prefill regression as decode drift as well.
+        sub_findings = {
+            id(f) for sub in report.sub_reports.values() for f in sub.findings
+        }
+        program_errors = [
+            f
+            for f in report.errors
+            if not f.code.startswith("CONTRACT_") and id(f) not in sub_findings
+        ]
+        if len(program_errors) > exp.get("max_errors", 0):
+            self._drift(
+                findings, "errors", exp.get("max_errors", 0), len(program_errors)
+            )
+
+        exp_coll = exp.get("collectives")
+        if exp_coll is not None and not degraded:
+            actual_coll = report.inventory.get("collectives", {})
+            for kind in sorted(set(exp_coll) | set(actual_coll)):
+                e = exp_coll.get(kind, {"count": 0, "bytes": 0})
+                a = actual_coll.get(kind, {"count": 0, "bytes": 0})
+                if int(a.get("count", 0)) != int(e.get("count", 0)):
+                    self._drift(
+                        findings,
+                        f"collectives.{kind}.count",
+                        int(e.get("count", 0)),
+                        int(a.get("count", 0)),
+                    )
+                else:
+                    bytes_drift(
+                        f"collectives.{kind}.bytes",
+                        int(e.get("bytes", 0)),
+                        int(a.get("bytes", 0)),
+                    )
+
+        exp_don = exp.get("donation")
+        if exp_don is not None:
+            actual_don = report.inventory.get("donation", {})
+            for key in ("declared", "aliased"):
+                if int(actual_don.get(key, 0)) != int(exp_don.get(key, 0)):
+                    self._drift(
+                        findings,
+                        f"donation.{key}",
+                        int(exp_don.get(key, 0)),
+                        int(actual_don.get(key, 0)),
+                    )
+
+        for section, fields in (
+            ("memory", ("peak_hbm_bytes", "temp_bytes")),
+            ("schedule", ("serialized_comm_bytes",)),
+        ):
+            exp_sec = exp.get(section)
+            if exp_sec is None or degraded:
+                continue
+            actual_sec = report.inventory.get(section)
+            if not actual_sec:
+                findings.append(
+                    Finding(
+                        "CONTRACT_DRIFT",
+                        f"{self.program}: contract pins {section} but the "
+                        "report carries none — audit with compile=True to "
+                        "check it",
+                        severity=WARNING,
+                        path=f"{self.program}:{section}",
+                        data={"program": self.program, "field": section},
+                    )
+                )
+                continue
+            for key in fields:
+                if key in exp_sec:
+                    bytes_drift(
+                        f"{section}.{key}", int(exp_sec[key]), int(actual_sec.get(key, 0))
+                    )
+        exp_sched = exp.get("schedule")
+        if exp_sched is not None and not degraded and "overlapped_count" in exp_sched:
+            actual_sched = report.inventory.get("schedule")
+            if actual_sched and int(actual_sched.get("overlapped_count", 0)) != int(
+                exp_sched["overlapped_count"]
+            ):
+                self._drift(
+                    findings,
+                    "schedule.overlapped_count",
+                    int(exp_sched["overlapped_count"]),
+                    int(actual_sched.get("overlapped_count", 0)),
+                )
+
+        budget = exp.get("compile_seconds_budget")
+        compile_s = report.meta.get("compile_seconds")
+        if budget is not None and compile_s is not None and not degraded:
+            ceiling = float(budget) * max(tolerance_scale, 1.0)
+            if float(compile_s) > ceiling:
+                # expected = the contract's recorded budget (the number the
+                # author can find in the JSON), not the scaled ceiling
+                self._drift(
+                    findings,
+                    "compile_seconds_budget",
+                    round(float(budget), 1),
+                    round(float(compile_s), 2),
+                    unit=" s",
+                )
+        return findings
+
+
+# -- the repo-wide gate --------------------------------------------------------
+
+
+def _expand(reports) -> list[tuple[AnalysisReport, AnalysisReport]]:
+    """Flatten merged reports one level as ``(root, report)`` pairs: the
+    engine's prefill spans and the fleet's per-replica audits are programs
+    with contracts of their own, but their drift must surface on the ROOT
+    report too — that's what renders, serializes, and drives exit codes."""
+    out: list[tuple[AnalysisReport, AnalysisReport]] = []
+    for report in reports:
+        out.append((report, report))
+        for sub in report.sub_reports.values():
+            out.append((report, sub))
+    return out
+
+
+def update_contract(
+    path: str,
+    report: AnalysisReport,
+    tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+    tolerance_scale: float = 1.0,
+) -> bool:
+    """Write/refresh one contract from a live report. Churn-free: when the
+    existing file still passes against the report (same environment, no
+    drift), it is left byte-identical. Refuses (returns False, file
+    untouched) when the existing contract was recorded on a DIFFERENT
+    environment, or pins compiled sections this report cannot re-derive
+    (lowered-only) — an update must never silently clobber expectations it
+    cannot reproduce. Returns True when the file changed."""
+    if os.path.exists(path):
+        existing = ProgramContract.load(path)
+        report_compiled = bool(report.meta.get("compiled", False))
+        if existing.compiled and not report_compiled:
+            return False
+        if existing.compiled or not report_compiled:
+            # like-for-like: rewrite only on a gate-failing (ERROR) drift —
+            # env skips and report-carries-no-section warnings must not
+            # regenerate the file (from_report would silently drop the very
+            # sections this report cannot reproduce). The remaining case
+            # (lowered-only contract, compiled report) always upgrades: the
+            # compiled audit strictly supersedes what lowering recorded.
+            findings = existing.check(report, tolerance_scale=tolerance_scale)
+            if not any(f.severity == ERROR for f in findings):
+                return False
+    ProgramContract.from_report(report, tolerance_pct=tolerance_pct).save(path)
+    return True
+
+
+def gate_reports(
+    reports,
+    contracts_dir: Optional[str] = None,
+    *,
+    update: bool = False,
+    tolerance_scale: float = 1.0,
+    require_contract: bool = True,
+) -> list[Finding]:
+    """Check (or, with ``update=True``, refresh) every contractable program
+    report against ``contracts_dir``. Drift findings are appended to the
+    report they belong to — so renders and jsonl records carry them — and
+    returned flat for the caller's exit code. With ``update``, the returned
+    findings are informational ``CONTRACT_*`` notes of what was written."""
+    contracts_dir = contracts_dir or default_contracts_dir()
+    all_findings: list[Finding] = []
+    for root, report in _expand(reports):
+        if not _is_program_report(report):
+            continue
+        label = report.meta["label"]
+        path = contract_path(contracts_dir, label)
+        if update:
+            changed = update_contract(path, report, tolerance_scale=tolerance_scale)
+            if changed:
+                all_findings.append(
+                    Finding(
+                        "CONTRACT_UPDATED",
+                        f"{label}: contract written to {path}",
+                        path=path,
+                    )
+                )
+            continue
+        if not os.path.exists(path):
+            if require_contract:
+                finding = Finding(
+                    "CONTRACT_MISSING",
+                    f"{label}: no contract at {path} — run with "
+                    "--update-contracts and commit the JSON",
+                    path=label,
+                )
+                report.add(finding)
+                if root is not report:
+                    root.add(finding)
+                all_findings.append(finding)
+            continue
+        contract = ProgramContract.load(path)
+        findings = contract.check(report, tolerance_scale=tolerance_scale)
+        report.extend(findings)
+        # a sub-program's drift must gate the whole audit: merge() copied the
+        # sub's findings into the root BEFORE this check ran, so the root's
+        # errors (the CLI exit code, the rendered report, the telemetry
+        # record) would otherwise never see it
+        if root is not report:
+            root.extend(findings)
+        all_findings.extend(findings)
+    return all_findings
+
+
+def drift_count(findings) -> int:
+    """ERROR-level contract drifts in a findings list — the bench metric."""
+    return sum(
+        1 for f in findings if f.code == "CONTRACT_DRIFT" and f.severity == ERROR
+    )

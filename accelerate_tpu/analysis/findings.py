@@ -87,6 +87,63 @@ CATALOG: dict[str, CatalogEntry] = {
             "when a sharding config regresses to replication.",
             "bert params replicated under the default data-parallel mesh",
         ),
+        # HBM memory audit (memory.py)
+        CatalogEntry(
+            "TEMP_BLOWUP", WARNING,
+            "Temp-buffer bytes dwarf the program's argument bytes",
+            "XLA materialized intermediates far larger than the live state — "
+            "look for a missing remat policy, an accidental full-precision "
+            "upcast, or a transpose that defeated fusion.",
+            "a step program with 80 MiB of arguments and 900 MiB of temps",
+        ),
+        CatalogEntry(
+            "HBM_OVER_BUDGET", ERROR,
+            "The program's peak-HBM estimate exceeds the caller's budget",
+            "Shrink the program (remat, sharding, smaller buckets) or raise "
+            "the budget deliberately — this gate exists so HBM growth is a "
+            "reviewed decision, not a surprise OOM at deploy.",
+            "a decode program estimated at 17.2 GiB against a 16 GiB budget",
+        ),
+        # collective-schedule pass (schedule.py)
+        CatalogEntry(
+            "SERIALIZED_COLLECTIVE", INFO,
+            "Collectives run serialized with no compute overlapping them",
+            "Inventory for the comm/compute-overlap work: serialized-comm "
+            "bytes sit on the critical path. Decompose (reduce-scatter + "
+            "all-gather) and overlap the gathers with forward compute.",
+            "26 all-reduces (1.3 MiB) with their consumers immediately behind them",
+        ),
+        # program contracts (contracts.py)
+        CatalogEntry(
+            "CONTRACT_DRIFT", ERROR,
+            "A measured program property drifted from its checked-in contract",
+            "Either the change is a regression (fix it) or the new value is "
+            "intended — rerun with --update-contracts and commit the diff so "
+            "the expectation moves in review, not silently.",
+            "collectives.all_gather.count: expected 0, got 1 (+1)",
+        ),
+        CatalogEntry(
+            "CONTRACT_MISSING", WARNING,
+            "An audited program has no checked-in contract",
+            "Run `accelerate-tpu analyze --self-check --contracts "
+            "--update-contracts` and commit the generated JSON so the next "
+            "change to this program is diffable.",
+            "a new prefill span bucket with no tests/contracts entry",
+        ),
+        CatalogEntry(
+            "CONTRACT_UPDATED", INFO,
+            "A contract file was written/refreshed by --update-contracts",
+            "Commit the JSON diff — the moved expectation is the change's "
+            "measured effect, stated in collected numbers.",
+            "bert_tiny_step: contract written to tests/contracts/bert_tiny_step.json",
+        ),
+        CatalogEntry(
+            "CONTRACT_ENV_SKIPPED", INFO,
+            "A contract was skipped because it was recorded on a different environment",
+            "Contracts pin backend + device count (collective counts depend on "
+            "both). Regenerate on this environment to gate here too.",
+            "an 8-device CPU-mesh contract checked on a 1-device laptop run",
+        ),
         # runtime sanitizer (sanitizer.py)
         CatalogEntry(
             "HOST_SYNC", ERROR,
@@ -235,6 +292,10 @@ class AnalysisReport:
     findings: list[Finding] = field(default_factory=list)
     inventory: dict = field(default_factory=dict)
     meta: dict = field(default_factory=dict)
+    # merged sub-program reports by prefix (engine prefill spans, fleet
+    # replicas) — kept object-level for the contract gate to walk; the
+    # serialized form stays flat (their inventories land under the prefix)
+    sub_reports: dict = field(default_factory=dict, repr=False)
 
     def add(self, finding: Finding) -> None:
         self.findings.append(finding)
@@ -246,6 +307,7 @@ class AnalysisReport:
         self.findings.extend(other.findings)
         if prefix:
             self.inventory[prefix] = other.inventory
+            self.sub_reports[prefix] = other
         else:
             self.inventory.update(other.inventory)
 
@@ -307,6 +369,28 @@ class AnalysisReport:
             lines.append(
                 f"  donation: {donation.get('aliased', 0)}/{donation.get('declared', 0)} "
                 f"declared buffers aliased"
+            )
+        memory = self.inventory.get("memory")
+        if memory:
+            lines.append(
+                "  memory: peak-HBM est {:.1f} MiB (args {:.1f} + out {:.1f} "
+                "+ temp {:.1f} − alias {:.1f})".format(
+                    memory.get("peak_hbm_bytes", 0) / (1 << 20),
+                    memory.get("argument_bytes", 0) / (1 << 20),
+                    memory.get("output_bytes", 0) / (1 << 20),
+                    memory.get("temp_bytes", 0) / (1 << 20),
+                    memory.get("donation_saved_bytes", 0) / (1 << 20),
+                )
+            )
+        schedule = self.inventory.get("schedule")
+        if schedule and schedule.get("total_count"):
+            lines.append(
+                "  schedule: {}/{} collectives overlapped; serialized comm "
+                "{:.2f} MiB on the critical path".format(
+                    schedule.get("overlapped_count", 0),
+                    schedule.get("total_count", 0),
+                    schedule.get("serialized_comm_bytes", 0) / (1 << 20),
+                )
             )
         return "\n".join(lines)
 

@@ -1,0 +1,125 @@
+"""HBM memory audit: price what the executable will actually hold.
+
+``compiled.memory_analysis()`` is XLA's own buffer-assignment summary —
+argument, output, temp, and alias bytes for the exact program that will run.
+Those four numbers answer the capacity questions every deploy asks and no
+Python review can: *what does a step really cost in HBM* (peak estimate),
+*what did donation actually save* (alias bytes — the buffers that exist once
+instead of twice), and *did the compiler materialize a temp working set far
+larger than the live state* (a missing remat policy, a fusion-defeating
+transpose, an accidental upcast).
+
+Two findings:
+
+- ``TEMP_BLOWUP`` (warning) — temp bytes exceed ``temp_blowup_factor`` ×
+  argument bytes AND an absolute floor (tiny programs with proportionally
+  large scratch are not a capacity problem).
+- ``HBM_OVER_BUDGET`` (error) — the peak-HBM estimate exceeds a caller-
+  supplied budget. Off by default; contracts (contracts.py) pin the measured
+  peak per program instead, which is the repo's own budget line.
+
+The summary lands in ``report.inventory["memory"]`` and is the diffable
+observable: the paged-KV PR's "−46.5% HBM/request" and the coming ZeRO PR's
+sharded-optimizer-state savings are exactly moves of these numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .findings import Finding
+
+# temp/argument ratio above which TEMP_BLOWUP fires — 4× means the compiler's
+# scratch dwarfs the live state the caller sized the chip for
+DEFAULT_TEMP_BLOWUP_FACTOR = 4.0
+# ...but only when the temps are big enough to matter on real HBM
+TEMP_BLOWUP_FLOOR_BYTES = 64 << 20
+
+
+def memory_summary(compiled) -> Optional[dict]:
+    """Raw byte accounting from the executable's buffer assignment, or None
+    when the backend exposes no ``memory_analysis()`` (older plugins)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+
+    def _field(name: str) -> int:
+        try:
+            return int(getattr(mem, name, 0) or 0)
+        except Exception:
+            return 0
+
+    argument = _field("argument_size_in_bytes")
+    output = _field("output_size_in_bytes")
+    temp = _field("temp_size_in_bytes")
+    alias = _field("alias_size_in_bytes")
+    code = _field("generated_code_size_in_bytes")
+    summary = {
+        "argument_bytes": argument,
+        "output_bytes": output,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": code,
+        # live peak: inputs + outputs coexist with the temp working set,
+        # minus the aliased bytes that are one buffer, not two — donation's
+        # saving priced in the same line that shows the budget
+        "peak_hbm_bytes": max(0, argument + output - alias) + temp + code,
+        "donation_saved_bytes": alias,
+    }
+    host = {
+        f"host_{k}_bytes": _field(f"host_{k}_size_in_bytes")
+        for k in ("argument", "output", "temp")
+    }
+    if any(host.values()):  # offload paths only; zero noise otherwise
+        summary.update(host)
+    return summary
+
+
+def memory_audit(
+    compiled,
+    label: str = "program",
+    *,
+    hbm_budget_bytes: Optional[int] = None,
+    temp_blowup_factor: float = DEFAULT_TEMP_BLOWUP_FACTOR,
+    temp_blowup_floor_bytes: int = TEMP_BLOWUP_FLOOR_BYTES,
+) -> tuple[list[Finding], dict]:
+    """Audit one executable's HBM footprint. Returns ``(findings, summary)``;
+    the summary is ``{}`` when the backend cannot report buffer sizes, so
+    callers can still diff the key's presence."""
+    summary = memory_summary(compiled)
+    if summary is None:
+        return [], {}
+    findings: list[Finding] = []
+    argument = summary["argument_bytes"]
+    temp = summary["temp_bytes"]
+    if temp >= temp_blowup_floor_bytes and temp > temp_blowup_factor * max(argument, 1):
+        findings.append(
+            Finding(
+                "TEMP_BLOWUP",
+                f"{label}: {temp / (1 << 20):.1f} MiB of temp buffers vs "
+                f"{argument / (1 << 20):.1f} MiB of arguments "
+                f"({temp / max(argument, 1):.1f}x, threshold {temp_blowup_factor:g}x)",
+                path=label,
+                data={
+                    "temp_bytes": temp,
+                    "argument_bytes": argument,
+                    "factor": round(temp / max(argument, 1), 2),
+                },
+            )
+        )
+    peak = summary["peak_hbm_bytes"]
+    if hbm_budget_bytes is not None and peak > hbm_budget_bytes:
+        findings.append(
+            Finding(
+                "HBM_OVER_BUDGET",
+                f"{label}: peak-HBM estimate {peak / (1 << 20):.1f} MiB exceeds "
+                f"the {hbm_budget_bytes / (1 << 20):.1f} MiB budget by "
+                f"{(peak - hbm_budget_bytes) / (1 << 20):.1f} MiB",
+                path=label,
+                data={"peak_hbm_bytes": peak, "budget_bytes": int(hbm_budget_bytes)},
+            )
+        )
+    return findings, summary

@@ -24,14 +24,25 @@ from typing import Any, Optional
 from .findings import ERROR, INFO, WARNING, AnalysisReport, Finding
 
 # -- type parsing (shared by StableHLO `tensor<4x4xf32>` and HLO `f32[4,4]`) --
+#
+# Sizes are BITS so the sub-byte quantized types size correctly (s4/i4 pack
+# two elements per byte). The int8 serving path (`from_streamed` + on-device
+# dequant) lowers to `tensor<...xi8>`/`tensor<...xui8>` in StableHLO and
+# `s8[...]`/`u8[...]` in post-SPMD HLO — both spellings of both signednesses
+# must parse, or int8 collectives and baked int8 tables vanish from the
+# inventory (and from the contracts built on it).
 
-_DTYPE_BYTES = {
-    "pred": 1, "i1": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "i8": 1,
-    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1,
-    "s16": 2, "u16": 2, "i16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "i32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "i64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
+_DTYPE_BITS = {
+    "pred": 8, "i1": 8,  # XLA stores predicates one per byte
+    "s2": 2, "u2": 2, "i2": 2, "ui2": 2,
+    "s4": 4, "u4": 4, "i4": 4, "ui4": 4, "f4e2m1fn": 4,
+    "s8": 8, "u8": 8, "i8": 8, "ui8": 8,
+    "f8e4m3fn": 8, "f8e5m2": 8, "f8e4m3": 8, "f8e4m3b11fnuz": 8, "f8e5m2fnuz": 8,
+    "f8e8m0fnu": 8,
+    "s16": 16, "u16": 16, "i16": 16, "ui16": 16, "f16": 16, "bf16": 16,
+    "s32": 32, "u32": 32, "i32": 32, "ui32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "i64": 64, "ui64": 64, "f64": 64, "c64": 64,
+    "c128": 128,
 }
 
 _STABLEHLO_TYPE = re.compile(r"tensor<((?:[0-9]+x)*)([a-z][a-z0-9]*)>")
@@ -48,12 +59,13 @@ def _numel(dims: str, sep: str) -> int:
 
 def type_bytes(match: "re.Match", stablehlo: bool) -> Optional[int]:
     """Byte size of one parsed tensor type; None for unknown dtypes (tokens,
-    tuples) so callers can skip rather than miscount."""
+    tuples) so callers can skip rather than miscount. Sub-byte types round
+    up to whole bytes per tensor (the packed buffer's footprint)."""
     dims, dtype = (match.group(1), match.group(2)) if stablehlo else (match.group(2), match.group(1))
-    per = _DTYPE_BYTES.get(dtype)
-    if per is None:
+    bits = _DTYPE_BITS.get(dtype)
+    if bits is None:
         return None
-    return _numel(dims, "x" if stablehlo else ",") * per
+    return -(-(_numel(dims, "x" if stablehlo else ",") * bits) // 8)
 
 
 def _last_type_bytes(line: str) -> Optional[int]:
@@ -371,27 +383,88 @@ def constant_audit(
 _COLLECTIVES = {
     "all_reduce": (("stablehlo.all_reduce",), ("all-reduce(", "all-reduce-start(")),
     "all_gather": (("stablehlo.all_gather",), ("all-gather(", "all-gather-start(")),
-    "reduce_scatter": (("stablehlo.reduce_scatter",), ("reduce-scatter(",)),
+    "reduce_scatter": (
+        ("stablehlo.reduce_scatter",),
+        ("reduce-scatter(", "reduce-scatter-start("),
+    ),
     "collective_permute": (
         ("stablehlo.collective_permute",),
         ("collective-permute(", "collective-permute-start("),
     ),
-    "all_to_all": (("stablehlo.all_to_all",), ("all-to-all(",)),
+    "all_to_all": (("stablehlo.all_to_all",), ("all-to-all(", "all-to-all-start(")),
 }
+
+_INSTR_PREFIX_RE = re.compile(r"^\s*(?:ROOT\s+)?%[\w.-]+\s*=\s*")
+
+
+def _result_type_sizes(line: str) -> list[int]:
+    """Byte sizes of the types in an HLO instruction's RESULT region — the
+    single token after ``=`` for plain results, the balanced-paren prefix for
+    tuple results (async starts, combined sync collectives)."""
+    m = _INSTR_PREFIX_RE.match(line)
+    region = line[m.end():] if m else line
+    paren = region.find("(")
+    space = region.find(" ")
+    if paren != -1 and (space == -1 or paren < space):
+        depth, end = 0, -1
+        for i, ch in enumerate(region):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        if end != -1:
+            region = region[: end + 1]
+    else:
+        region = region.split(None, 1)[0]
+    sizes = [type_bytes(t, True) for t in _STABLEHLO_TYPE.finditer(region)]
+    sizes += [type_bytes(t, False) for t in _HLO_TYPE.finditer(region)]
+    return [s for s in sizes if s is not None]
+
+
+def start_result_bytes(line: str) -> int:
+    """Byte size of an async START op's result — the payload in flight. Real
+    XLA starts are tuple-typed ``(operand_type, result_type, ...)``, so the
+    FIRST type on the line is the (smaller, for all-gather) input shape; take
+    the largest type in the result region instead. Falls back to the first
+    parseable type for non-tuple spellings."""
+    sizes = _result_type_sizes(line)
+    if sizes:
+        return max(sizes)
+    return _first_type_bytes(line) or 0
+
+
+def sync_result_bytes(line: str) -> int:
+    """Byte size of a SYNC collective's result. XLA's combiner passes emit
+    tuple-typed combined ops (``(f32[1000], f32[2000]) all-reduce(%a, %b)``)
+    whose total payload is the SUM of the elements — first-type sizing would
+    undercount every combined collective."""
+    sizes = _result_type_sizes(line)
+    if sizes:
+        return sum(sizes)
+    return _first_type_bytes(line) or 0
 
 
 def collective_inventory(text: str) -> dict[str, dict]:
     """Count + size every cross-device collective in a program text (HLO or
     StableHLO). Bytes are the op result size — the payload that rides the
     interconnect — so a sharding regression (e.g. a new all-gather of a full
-    parameter) shows up as a diffable number, not a vibe."""
+    parameter) shows up as a diffable number, not a vibe. Async start ops
+    count once (the done is a different opcode) and size from the start's
+    tuple RESULT, not its operand."""
     out: dict[str, dict] = {}
     for line in text.splitlines():
         for kind, (shlo_pats, hlo_pats) in _COLLECTIVES.items():
             if any(p in line for p in shlo_pats):
                 nbytes = _last_type_bytes(line) or 0
             elif any(p in line for p in hlo_pats):
-                nbytes = _first_type_bytes(line) or 0
+                nbytes = (
+                    start_result_bytes(line)
+                    if "-start(" in line
+                    else sync_result_bytes(line)
+                ) or 0
             else:
                 continue
             entry = out.setdefault(kind, {"count": 0, "bytes": 0})
@@ -469,15 +542,19 @@ def audit_lowered(
     expect_donation: bool = True,
     constant_threshold_bytes: int = 1 << 20,
     replication_threshold_bytes: int = 1 << 20,
+    hbm_budget_bytes: Optional[int] = None,
+    temp_blowup_factor: Optional[float] = None,
 ) -> AnalysisReport:
     """Run every program pass over one ``jax.stages.Lowered``.
 
     With ``compile=True`` (or a pre-built ``compiled``), the post-SPMD
     executable feeds the collective inventory, the executable-level alias
-    table, and the replication audit — the properties GSPMD only decides at
-    compile time. ``compile=False`` keeps the audit trace-only (donation
-    declaration, dtype, constants) for callers who cannot afford a second
-    XLA compile.
+    table, the replication audit, the HBM memory audit (memory.py), and the
+    collective-overlap schedule pass (schedule.py) — the properties GSPMD
+    only decides at compile time. ``compile=False`` keeps the audit
+    trace-only (donation declaration, dtype, constants) for callers who
+    cannot afford a second XLA compile. ``hbm_budget_bytes`` arms the
+    ``HBM_OVER_BUDGET`` gate on the peak-HBM estimate.
     """
     import jax
 
@@ -513,6 +590,27 @@ def audit_lowered(
         )
         report.extend(repl_findings)
         inventory["replication"] = repl_summary
+        # the executable-only passes (lazy imports: schedule.py imports this
+        # module's type parsers, so the dependency must point one way)
+        from .memory import DEFAULT_TEMP_BLOWUP_FACTOR, memory_audit
+        from .schedule import schedule_audit
+
+        mem_findings, mem_summary = memory_audit(
+            compiled,
+            label=label,
+            hbm_budget_bytes=hbm_budget_bytes,
+            temp_blowup_factor=(
+                DEFAULT_TEMP_BLOWUP_FACTOR
+                if temp_blowup_factor is None
+                else temp_blowup_factor
+            ),
+        )
+        report.extend(mem_findings)
+        if mem_summary:
+            inventory["memory"] = mem_summary
+        sched_findings, sched_summary = schedule_audit(comp_text, label=label)
+        report.extend(sched_findings)
+        inventory["schedule"] = sched_summary
     else:
         # pre-partitioning StableHLO only names collectives the user wrote
         # explicitly (shard_map); GSPMD's inserted ones need the executable

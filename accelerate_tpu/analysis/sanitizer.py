@@ -32,8 +32,8 @@ Three fused feeds:
 
 :func:`explain_recompile` answers the follow-up question a recompile finding
 always raises — *which argument retraced?* — by diffing two abstract
-signatures (shape/dtype per pytree leaf, repr for static leaves) and naming
-exactly the leaves that changed. ``HazardSanitizer.watch(step)`` wraps a step
+signatures (shape/dtype/weak-type per pytree leaf, repr for static leaves)
+and naming exactly the leaves that changed. ``HazardSanitizer.watch(step)`` wraps a step
 callable to capture those signatures per call and attach the diff to the
 finding (and, via the telemetry hub, to the ``{"kind": "compile"}`` record in
 ``telemetry.jsonl``).
@@ -60,8 +60,12 @@ _active_sanitizers: list["HazardSanitizer"] = []
 
 def signature_of(tree: Any) -> dict[str, str]:
     """Abstract signature of a pytree of call arguments: ``path ->
-    "shape/dtype"`` for array leaves, ``repr`` for static leaves (whose value
-    IS part of the trace key). Cheap — no device access, no hashing of data."""
+    "shape/dtype"`` for array leaves (with a ``/weak`` suffix for weak-typed
+    arrays — a Python-scalar-born ``jnp.asarray(0.0)`` and an explicit
+    ``jnp.float32(0.0)`` share shape and dtype but are DIFFERENT trace keys,
+    and without the suffix that retrace would diff as "identical
+    signatures"), ``repr`` for static leaves (whose value IS part of the
+    trace key). Cheap — no device access, no hashing of data."""
     import jax
 
     from .program import _keystr
@@ -71,7 +75,10 @@ def signature_of(tree: Any) -> dict[str, str]:
     for path, leaf in flat:
         key = _keystr(path)
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-            out[key] = f"{tuple(leaf.shape)}/{leaf.dtype}"
+            weak = getattr(leaf, "weak_type", None)
+            if weak is None:
+                weak = getattr(getattr(leaf, "aval", None), "weak_type", False)
+            out[key] = f"{tuple(leaf.shape)}/{leaf.dtype}" + ("/weak" if weak else "")
         else:
             out[key] = f"static:{leaf!r}"[:120]
     return out

@@ -1,0 +1,252 @@
+"""Collective-overlap schedule pass: is comm hidden behind compute, or not?
+
+The collective *inventory* (program.py) says what a program communicates;
+it cannot say what that communication costs in wall-clock, because the cost
+depends on the schedule: an all-gather whose consumer immediately follows it
+serializes the interconnect into the critical path, while the same op issued
+as an ``all-gather-start`` with independent compute before its
+``all-gather-done`` is (up to bandwidth) free. This pass reads the post-SPMD
+HLO and classifies every collective:
+
+- **async pairs** — ``all-gather-start``/``all-gather-done``,
+  ``all-reduce-start``/``-done``, ``collective-permute-start``/``-done``:
+  matched by the done op consuming the start's value. The pair is
+  **overlapped** when at least one real compute op that does *not* depend on
+  the start sits between them in instruction order, else **serialized** (the
+  consumer is right behind the start — the async form bought nothing).
+- **sync ops** — plain ``all-reduce(...)`` etc. (XLA:CPU emits only these):
+  serialized by definition.
+
+The observable is ``serialized_comm_bytes`` — result bytes of every
+serialized collective, i.e. the payload sitting on the critical path. This
+is the number the ZeRO-style weight-update sharding + overlap work (ROADMAP;
+arXiv:2004.13336, SimpleFSDP arXiv:2411.00284) exists to move, and the
+contract gate (contracts.py) pins so it cannot regress silently afterwards.
+
+Classification reads instruction order, which is execution order when the
+module is scheduled (``is_scheduled=true`` in the header — recorded in the
+summary) and a topological-order approximation otherwise.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .findings import Finding
+from .program import start_result_bytes, sync_result_bytes
+
+# async opcode -> canonical collective kind
+_ASYNC_START = {
+    "all-gather-start": "all_gather",
+    "all-reduce-start": "all_reduce",
+    "reduce-scatter-start": "reduce_scatter",
+    "collective-permute-start": "collective_permute",
+    "all-to-all-start": "all_to_all",
+}
+_SYNC_OPS = {
+    "all-gather": "all_gather",
+    "all-reduce": "all_reduce",
+    "reduce-scatter": "reduce_scatter",
+    "collective-permute": "collective_permute",
+    "all-to-all": "all_to_all",
+}
+_DONE_FOR = {start: start[: -len("start")] + "done" for start in _ASYNC_START}
+
+# ops that move/rename data rather than compute — sitting between a start and
+# its done, they hide no communication latency
+_NON_COMPUTE = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "bitcast-convert", "copy", "copy-start", "copy-done", "reshape",
+    "broadcast", "iota", "convert", "transpose", "slice", "concatenate",
+    "after-all", "partition-id", "replica-id", "domain", "opt-barrier",
+    "send", "send-done", "recv", "recv-done", "infeed", "outfeed",
+    "add-dependency",
+}
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.-]+)\s*=\s*")
+_OPERAND_RE = re.compile(r"%([\w.-]+)")
+
+
+def _opcode_of(line: str) -> str:
+    """Opcode of one HLO instruction line ('' when the line is not one).
+    The result type may be a tuple with nested parens/spaces, so the type is
+    skipped structurally, not by regex."""
+    m = _DEF_RE.match(line)
+    if not m:
+        return ""
+    rest = line[m.end():].lstrip()
+    if rest.startswith("("):  # tuple result type: skip balanced parens
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rest = rest[i + 1:].lstrip()
+                    break
+        else:
+            return ""
+    else:  # scalar/array type: one whitespace-free token
+        parts = rest.split(None, 1)
+        if len(parts) < 2:
+            return ""
+        rest = parts[1]
+    op = re.match(r"([\w-]+)\s*\(", rest)
+    return op.group(1) if op else ""
+
+
+def _operands_of(line: str) -> list[str]:
+    """%names consumed by the instruction (everything after the opcode's
+    opening paren — includes control deps, which is fine for tainting)."""
+    m = _DEF_RE.match(line)
+    if not m:
+        return []
+    paren = line.find("(", m.end())
+    return _OPERAND_RE.findall(line[paren + 1:]) if paren != -1 else []
+
+
+def _computations(text: str):
+    """Yield lists of instruction lines, one per HLO computation."""
+    current: list[str] = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{"):
+            current = []
+        elif stripped.startswith("}"):
+            if current:
+                yield current
+            current = []
+        elif " = " in stripped:
+            current.append(stripped)
+    if current:
+        yield current
+
+
+def collective_schedule(text: str) -> dict:
+    """Classify every collective in a post-SPMD HLO text. Returns the
+    schedule summary (see module docstring); ``collectives`` lists each op
+    with its classification for the report's jsonl sink."""
+    ops: list[dict] = []
+    for lines in _computations(text):
+        # parse each line exactly once — the overlap walk below revisits
+        # later instructions per async start, and a real overlap-heavy FSDP
+        # module has hundreds of starts over very long HLO texts
+        defs = []
+        for l in lines:
+            m = _DEF_RE.match(l)
+            if m is None:
+                defs.append((None, l, "", ()))
+            else:
+                defs.append((m.group(1), l, _opcode_of(l), _operands_of(l)))
+        for idx, (name, line, opcode, _) in enumerate(defs):
+            if name is None:
+                continue
+            kind = _SYNC_OPS.get(opcode)
+            if kind is not None:
+                ops.append(
+                    {
+                        "kind": kind,
+                        "name": name,
+                        "bytes": sync_result_bytes(line),
+                        "async": False,
+                        "overlapped": False,
+                        "overlap_compute_ops": 0,
+                    }
+                )
+                continue
+            if opcode not in _ASYNC_START:
+                continue
+            done_op = _DONE_FOR[opcode]
+            tainted = {name}
+            overlap_ops = 0
+            done_line = None
+            for later_name, later_line, later_opcode, operands in defs[idx + 1:]:
+                if later_name is None:
+                    continue
+                depends = any(o in tainted for o in operands)
+                if later_opcode == done_op and name in operands:
+                    done_line = later_line
+                    break
+                if depends:
+                    tainted.add(later_name)
+                elif (
+                    later_opcode
+                    and later_opcode not in _NON_COMPUTE
+                    and later_opcode not in _SYNC_OPS
+                    and later_opcode not in _ASYNC_START
+                    and not later_opcode.endswith("-done")
+                ):
+                    overlap_ops += 1
+            # a done's result is the received payload; combined dones are
+            # tuple-typed, so sum like any sync result
+            nbytes = sync_result_bytes(done_line) if done_line else 0
+            if not nbytes:  # unmatched done (cross-computation): size the start
+                nbytes = start_result_bytes(line)
+            ops.append(
+                {
+                    "kind": _ASYNC_START[opcode],
+                    "name": name,
+                    "bytes": nbytes,
+                    "async": True,
+                    # an unmatched done (async-wrapped in another computation)
+                    # means the walk saw the rest of the computation, not the
+                    # start→done window — classify conservatively as
+                    # serialized rather than crediting overlap never proven
+                    "overlapped": done_line is not None and overlap_ops > 0,
+                    "overlap_compute_ops": overlap_ops if done_line is not None else 0,
+                }
+            )
+
+    per_kind: dict[str, dict] = {}
+    serialized_bytes = 0
+    overlapped_bytes = 0
+    for op in ops:
+        entry = per_kind.setdefault(
+            op["kind"],
+            {"count": 0, "bytes": 0, "overlapped_count": 0, "serialized_bytes": 0},
+        )
+        entry["count"] += 1
+        entry["bytes"] += op["bytes"]
+        if op["overlapped"]:
+            entry["overlapped_count"] += 1
+            overlapped_bytes += op["bytes"]
+        else:
+            entry["serialized_bytes"] += op["bytes"]
+            serialized_bytes += op["bytes"]
+    return {
+        "scheduled": "is_scheduled=true" in text,
+        "total_count": len(ops),
+        "async_count": sum(1 for op in ops if op["async"]),
+        "overlapped_count": sum(1 for op in ops if op["overlapped"]),
+        "serialized_count": sum(1 for op in ops if not op["overlapped"]),
+        "overlapped_comm_bytes": overlapped_bytes,
+        "serialized_comm_bytes": serialized_bytes,
+        "per_kind": per_kind,
+        # cap the per-op listing: a 60-collective program stays readable in
+        # jsonl; the aggregates above are the diffed surface anyway
+        "collectives": ops[:128],
+    }
+
+
+def schedule_audit(text: str, label: str = "program") -> tuple[list[Finding], dict]:
+    """Run the schedule pass over one compiled program's HLO text."""
+    summary = collective_schedule(text)
+    findings: list[Finding] = []
+    if summary["serialized_count"]:
+        findings.append(
+            Finding(
+                "SERIALIZED_COLLECTIVE",
+                f"{label}: {summary['serialized_count']} of "
+                f"{summary['total_count']} collectives run serialized "
+                f"({summary['serialized_comm_bytes'] / (1 << 20):.2f} MiB of "
+                "comm on the critical path)",
+                path=label,
+                data={
+                    "serialized_count": summary["serialized_count"],
+                    "serialized_comm_bytes": summary["serialized_comm_bytes"],
+                    "overlapped_count": summary["overlapped_count"],
+                },
+            )
+        )
+    return findings, summary

@@ -1,6 +1,6 @@
 """`accelerate-tpu analyze` — the static-analysis front door.
 
-Two modes that compose:
+Three modes that compose:
 
 1. **Source lint** (default): AST-lint the given files/directories for
    trace-time hazards in jit-traced functions — branching on traced values,
@@ -10,14 +10,26 @@ Two modes that compose:
 
        accelerate-tpu analyze train.py my_pkg/ --strict
 
-2. **Self-check** (``--self-check``): build the repo's own bert-tiny fused
-   step program, a llama-tiny serving decode program, and the routed
-   (2-replica fleet) decode path, and run the full compiled-program audit
-   (donation aliasing, fp64, constants, collective inventory, replication)
-   over each — the same gate ``tests/test_analysis.py`` enforces, runnable
-   anywhere::
+2. **Self-check** (``--self-check``): build the repo's own canonical
+   programs — the bert-tiny fused step, a llama-tiny FSDP step (sharded
+   intent, the comm/compute-overlap baseline), a llama-tiny serving engine
+   (paged decode + every prefill chunk-span program), and the routed
+   2-replica decode path — and run the full compiled-program audit
+   (donation aliasing, fp64, constants, collective inventory, replication,
+   HBM memory, collective-overlap schedule) over each::
 
        accelerate-tpu analyze --self-check
+
+3. **Contract gate** (``--contracts``, implies ``--self-check``): check
+   every self-check program against its checked-in contract under
+   ``tests/contracts/`` and exit 1 on drift, naming exactly which
+   expectation moved and by how much. ``--update-contracts`` refreshes the
+   JSON instead (churn-free: an undrifted contract stays byte-identical) —
+   run it when a change *intends* to move a program property, and commit
+   the diff::
+
+       accelerate-tpu analyze --self-check --contracts            # the gate
+       accelerate-tpu analyze --self-check --update-contracts    # move it
 
 ``--json`` emits the machine-readable report (findings + inventory) for
 diffing across commits. The findings catalog lives in docs/analysis.md.
@@ -26,6 +38,11 @@ diffing across commits. The findings catalog lives in docs/analysis.md.
 from __future__ import annotations
 
 import json
+
+# the environment contracts are recorded on: an 8-way virtual CPU mesh
+# (mirrors tests/conftest.py), so the CLI gate and the tier-1 self-gate audit
+# the same programs with the same collective counts
+_CONTRACT_CPU_DEVICES = 8
 
 
 def register_subcommand(subparsers):
@@ -39,11 +56,23 @@ def register_subcommand(subparsers):
     )
     parser.add_argument(
         "--self-check", action="store_true",
-        help="Audit the repo's own bert-tiny step + llama-tiny decode programs",
+        help="Audit the repo's own bert/llama step + serving decode programs",
     )
     parser.add_argument(
         "--no-compile", action="store_true",
         help="Self-check: skip the AOT compile (trace-level audit only)",
+    )
+    parser.add_argument(
+        "--contracts", action="store_true",
+        help="Check self-check programs against tests/contracts/*.json; exit 1 on drift",
+    )
+    parser.add_argument(
+        "--update-contracts", action="store_true",
+        help="Refresh the contract JSONs from this run instead of checking",
+    )
+    parser.add_argument(
+        "--contracts-dir", default=None,
+        help="Contract directory (default: the repo's tests/contracts)",
     )
     parser.add_argument("--json", action="store_true", help="Emit the machine-readable report")
     parser.add_argument(
@@ -54,9 +83,45 @@ def register_subcommand(subparsers):
     return parser
 
 
-def _self_check(compile: bool):
-    """The analyzer pointed at this repo's own hot paths — small configs, so
-    it runs on a laptop CPU in seconds and proves the plumbing end to end."""
+def _force_contract_mesh() -> None:
+    """Best-effort: match the contract-recording environment (8 virtual CPU
+    devices, mirroring tests/conftest.py) when running on CPU. XLA_FLAGS is
+    read at backend init, so this works whenever the self-check is the first
+    thing in the process to touch devices; once a backend is already live
+    (or on real accelerators) it is a no-op — the contract env check then
+    skips honestly instead of fabricating drift."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return  # the caller already chose a device count
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_CONTRACT_CPU_DEVICES}"
+    ).strip()
+    try:
+        import jax
+
+        # newer jax can force the count even after XLA_FLAGS was read
+        jax.config.update("jax_num_cpu_devices", _CONTRACT_CPU_DEVICES)
+    except Exception:
+        pass
+
+
+def _reset_state() -> None:
+    from ..state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def canonical_bert_program():
+    """The CANONICAL bert-tiny data-parallel program the ``bert_tiny_step``
+    contract is recorded from: batch sharded over the mesh so the grad
+    all-reduce inventory is part of the contract. ONE construction, shared
+    by the CLI self-check and tests/test_contracts.py's seeded regressions —
+    two hand-copied builders would let the gated program and the recorded
+    program silently diverge. Returns ``(accelerator, model, batch)``."""
     import numpy as np
 
     import jax
@@ -64,21 +129,46 @@ def _self_check(compile: bool):
     import optax
 
     from .. import Accelerator
-    from ..models import Bert, Llama
-    from ..serving import ServingEngine
+    from ..models import Bert
 
-    reports = []
+    _reset_state()
     accelerator = Accelerator()
     model = Bert("bert-tiny")
     accelerator.prepare_model(model)
     accelerator.prepare_optimizer(optax.adamw(1e-4))
     rng = np.random.default_rng(0)
     vocab = model.config.vocab_size
+    sharding = accelerator.state.data_sharding()
     batch = {
-        "input_ids": jnp.asarray(rng.integers(0, vocab, (8, 16)), jnp.int32),
-        "attention_mask": jnp.ones((8, 16), jnp.int32),
-        "labels": jnp.asarray(rng.integers(0, 2, (8,)), jnp.int32),
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, vocab, (8, 16)), jnp.int32), sharding
+        ),
+        "attention_mask": jax.device_put(jnp.ones((8, 16), jnp.int32), sharding),
+        "labels": jax.device_put(
+            jnp.asarray(rng.integers(0, 2, (8,)), jnp.int32), sharding
+        ),
     }
+    return accelerator, model, batch
+
+
+def _self_check(compile: bool):
+    """The analyzer pointed at this repo's own hot paths — small configs, so
+    it runs on a laptop CPU in seconds and proves the plumbing end to end.
+    These are the CANONICAL contract programs: tests/test_contracts.py runs
+    exactly this set, so the CLI gate and the tier-1 gate can never audit
+    different programs under the same contract names."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .. import Accelerator, FullyShardedDataParallelPlugin, ParallelismConfig
+    from ..models import Bert, Llama
+    from ..serving import ServingEngine
+
+    reports = []
+    accelerator, model, batch = canonical_bert_program()
     reports.append(
         accelerator.analyze(
             Bert.loss_fn(model), batch, compile=compile, label="bert_tiny_step",
@@ -86,12 +176,45 @@ def _self_check(compile: bool):
         )
     )
 
-    llama = Llama("llama-tiny")
-    lparams = llama.init(jax.random.key(0))
-    engine = ServingEngine(llama, lparams, num_slots=2, max_len=32)
-    reports.append(
-        engine.analyze(compile=compile, write_record=False)
+    # -- llama-tiny FSDP step: sharded intent, so replication regressions are
+    # ERRORs, and the gather/scatter schedule is the overlap-work baseline
+    _reset_state()
+    fsdp_acc = Accelerator(
+        parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
+        fsdp_plugin=FullyShardedDataParallelPlugin(stage=3),
     )
+    llama = Llama("llama-tiny")
+    fsdp_acc.prepare_model(llama)
+    fsdp_acc.prepare_optimizer(optax.adamw(3e-4))
+
+    def llama_loss(params, fbatch):
+        logits = llama.apply(params, fbatch["input_ids"])[:, :-1].astype(jnp.float32)
+        tgt = fbatch["input_ids"][:, 1:]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (lse - tgt_logit).mean()
+
+    rng = np.random.default_rng(0)
+    fsdp_batch = {
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, llama.config.vocab_size, (8, 32)), jnp.int32),
+            fsdp_acc.state.data_sharding(),
+        )
+    }
+    reports.append(
+        fsdp_acc.analyze(
+            llama_loss, fsdp_batch, compile=compile, label="llama_tiny_fsdp_step",
+            write_record=False,
+        )
+    )
+
+    # -- the serving engine: paged decode + EVERY prefill chunk-span program
+    # (prefill_chunk set, so the chunked-prefill span is contract-covered)
+    _reset_state()
+    lparams = llama.init(jax.random.key(0))
+    engine_kwargs = dict(num_slots=2, max_len=64, page_size=16, prefill_chunk=16)
+    engine = ServingEngine(llama, lparams, **engine_kwargs)
+    reports.append(engine.analyze(compile=compile, write_record=False))
 
     # the routed decode path: replication must not change the program, so a
     # 2-replica fleet's per-replica audits must come back exactly as clean
@@ -99,7 +222,7 @@ def _self_check(compile: bool):
     from ..serving import ServingRouter
 
     router = ServingRouter(
-        engine_factory=lambda: ServingEngine(llama, lparams, num_slots=2, max_len=32),
+        engine_factory=lambda: ServingEngine(llama, lparams, **engine_kwargs),
         num_replicas=2,
     )
     reports.append(router.analyze(compile=compile, write_record=False))
@@ -109,6 +232,16 @@ def _self_check(compile: bool):
 def run(args) -> int:
     from ..analysis import AnalysisReport, lint_paths
 
+    contracts_mode = args.contracts or args.update_contracts
+    if contracts_mode:
+        # --contracts implies --self-check even when lint paths are also
+        # given: the gate is over the canonical PROGRAM set, and a paths-only
+        # invocation silently checking zero contracts would read as "gate
+        # passed" to the CI job that asked for it
+        args.self_check = True
+    if args.self_check:
+        _force_contract_mesh()
+
     reports: list[AnalysisReport] = []
     if args.paths:
         reports.append(lint_paths(args.paths))
@@ -117,6 +250,15 @@ def run(args) -> int:
     if not reports:
         print("nothing to analyze: pass paths to lint and/or --self-check")
         return 1
+
+    contract_notes = []
+    if contracts_mode:
+        from ..analysis.contracts import default_contracts_dir, gate_reports
+
+        contracts_dir = args.contracts_dir or default_contracts_dir()
+        contract_notes = gate_reports(
+            reports, contracts_dir, update=args.update_contracts
+        )
 
     total_findings = 0
     total_errors = 0
@@ -128,6 +270,14 @@ def run(args) -> int:
             print()
         total_findings += len(report.findings)
         total_errors += len(report.errors)
+    if args.update_contracts and not args.json:
+        written = [f.path for f in contract_notes]
+        if written:
+            print(f"contracts updated ({len(written)}):")
+            for path in written:
+                print(f"  {path}")
+        else:
+            print("contracts unchanged (no expectation drifted)")
     if total_errors or (args.strict and total_findings):
         return 1
     return 0

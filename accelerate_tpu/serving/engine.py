@@ -1409,17 +1409,22 @@ class ServingEngine:
         compile: bool = True,
         include_prefill: bool = True,
         write_record: bool = True,
+        contracts_dir: Optional[str] = None,
         **audit_kwargs,
     ):
-        """Audit the decode program (and, lowered-only, each prefill-bucket
+        """Audit the decode program (and, lowered-only, each prefill-span
         program): donation aliasing, fp64 leaks, baked constants, collective
-        inventory, replication. Returns an
+        inventory, replication — plus, for the compiled decode, the HBM
+        memory audit and collective-overlap schedule pass. Returns an
         :class:`~.analysis.AnalysisReport`; the summary also lands as a
         ``{"kind": "analysis"}`` record when a telemetry hub is attached.
 
         ``compile=True`` builds one extra AOT executable of the decode step
         so post-GSPMD properties are audited. The engine's fixed shapes make
-        this exactly the program every steady-state step runs."""
+        this exactly the program every steady-state step runs.
+        ``contracts_dir`` checks the decode report AND every prefill-span
+        sub-report against their checked-in contracts (``serving_decode``,
+        ``serving_prefill_<span>``), appending any drift findings."""
         from ..analysis import Finding, audit_lowered
 
         report = audit_lowered(
@@ -1460,6 +1465,10 @@ class ServingEngine:
                     **audit_kwargs,
                 )
                 report.merge(sub, prefix=f"prefill_{bucket}")
+        if contracts_dir is not None:
+            from ..analysis.contracts import gate_reports
+
+            gate_reports([report], contracts_dir)
         if write_record and self.telemetry is not None:
             self.telemetry.write_record("analysis", {"analysis": report.to_dict()})
         return report

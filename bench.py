@@ -1173,15 +1173,27 @@ def bench_resilience() -> dict:
 def bench_analysis() -> dict:
     """Analyzer-on-the-benchmarks (docs/analysis.md): audit the bert + llama
     step programs and record analyzer wall time plus the collective
-    inventory, so collective counts/bytes become part of the tracked perf
-    trajectory — a sharding regression (a new all-gather, a collective that
-    doubled in bytes) shows up here as a diffable number before it shows up
-    as a slow step."""
+    inventory, the HBM memory audit, and the collective-overlap schedule
+    pass, so collective counts/bytes, peak-HBM, and serialized-comm bytes
+    become part of the tracked perf trajectory — a sharding regression (a
+    new all-gather, a collective that doubled in bytes, comm sliding onto
+    the critical path) shows up here as a diffable number before it shows
+    up as a slow step. The same reports are checked against their
+    tests/contracts entries: ``analysis_contract_drift_count`` must be 0
+    (on an environment matching the recorded contracts; elsewhere the check
+    skips honestly). ``BENCH_ANALYSIS_UPDATE_CONTRACTS=1`` refreshes the
+    bench-scale contract JSONs from this run instead — the reviewed-diff
+    path when a change intends to move one of these programs."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, ParallelismConfig
+    from accelerate_tpu.analysis.contracts import (
+        default_contracts_dir,
+        drift_count,
+        gate_reports,
+    )
     from accelerate_tpu.models import Bert, Llama
 
     result: dict = {}
@@ -1196,11 +1208,31 @@ def bench_analysis() -> dict:
         for kind, stats in sorted(report.inventory.get("collectives", {}).items()):
             result[f"{prefix}_collective_{kind}_count"] = stats["count"]
             result[f"{prefix}_collective_{kind}_mib"] = round(stats["bytes"] / (1 << 20), 3)
+        memory = report.inventory.get("memory")
+        if memory:
+            result[f"{prefix}_peak_hbm_mib"] = round(memory["peak_hbm_bytes"] / (1 << 20), 2)
+            result[f"{prefix}_temp_mib"] = round(memory["temp_bytes"] / (1 << 20), 2)
+            result[f"{prefix}_donation_saved_mib"] = round(
+                memory["donation_saved_bytes"] / (1 << 20), 2
+            )
+        schedule = report.inventory.get("schedule")
+        if schedule:
+            # the ZeRO/overlap PR's baseline: how much comm sits serialized
+            # on the critical path vs hidden behind independent compute
+            result[f"{prefix}_overlap_overlapped_count"] = schedule["overlapped_count"]
+            result[f"{prefix}_overlap_serialized_count"] = schedule["serialized_count"]
+            result[f"{prefix}_overlap_serialized_comm_bytes"] = schedule[
+                "serialized_comm_bytes"
+            ]
+            result[f"{prefix}_overlap_overlapped_comm_bytes"] = schedule[
+                "overlapped_comm_bytes"
+            ]
 
     # bert step: the primary bench section's exact program (data-parallel)
     _reset_state()
     accelerator = Accelerator(mixed_precision="bf16")
-    model = Bert(os.environ.get("BENCH_ANALYSIS_BERT", "bert-base"))
+    bert_name = os.environ.get("BENCH_ANALYSIS_BERT", "bert-base")
+    model = Bert(bert_name)
     accelerator.prepare_model(model)
     accelerator.prepare_optimizer(optax.adamw(2e-5))
     batch_size, seq_len = 32, 128
@@ -1214,10 +1246,20 @@ def bench_analysis() -> dict:
         "token_type_ids": jax.device_put(jnp.zeros((batch_size, seq_len), jnp.int32), sharding),
         "labels": jax.device_put(jnp.asarray(rng.integers(0, 2, (batch_size,)), jnp.int32), sharding),
     }
-    summarize(
-        "analysis_bert",
-        accelerator.analyze(Bert.loss_fn(model), batch, label="bert_step", write_record=False),
+    # contract labels are program identities: bench-scale contracts are
+    # checked in as bert_base_step / llama_125m_fsdp_step. An env override
+    # audits a DIFFERENT program (bench batch/seq, not self-check scale), so
+    # it must land under a name that can never collide with a canonical
+    # checked-in contract — BENCH_ANALYSIS_BERT=bert-tiny would otherwise
+    # drift (or, with update on, clobber) bert_tiny_step.json, which is
+    # recorded from the batch-8x16 self-check program
+    bert_label = bert_name.replace("-", "_") + "_step"
+    if bert_name != "bert-base":
+        bert_label += "_override"
+    bert_report = accelerator.analyze(
+        Bert.loss_fn(model), batch, label=bert_label, write_record=False
     )
+    summarize("analysis_bert", bert_report)
 
     # llama step: the FSDP section's program — sharded intent, so a large
     # param resolving to replication would fail the error gate here
@@ -1227,7 +1269,8 @@ def bench_analysis() -> dict:
         parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
         fsdp_plugin=FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=True),
     )
-    llama = Llama(os.environ.get("BENCH_ANALYSIS_LLAMA", "llama-125m"))
+    llama_name = os.environ.get("BENCH_ANALYSIS_LLAMA", "llama-125m")
+    llama = Llama(llama_name)
     accelerator.prepare_model(llama)
     accelerator.prepare_optimizer(optax.adamw(3e-4))
 
@@ -1244,9 +1287,24 @@ def bench_analysis() -> dict:
             accelerator.state.data_sharding(),
         )
     }
-    report = accelerator.analyze(loss_fn, lbatch, label="llama_fsdp_step", write_record=False)
+    llama_label = llama_name.replace("-", "_") + "_fsdp_step"
+    if llama_name != "llama-125m":
+        llama_label += "_override"
+    report = accelerator.analyze(loss_fn, lbatch, label=llama_label, write_record=False)
     summarize("analysis_llama", report)
     result["analysis_llama_errors"] = [str(f) for f in report.errors]
+
+    # the differential gate: both bench-scale reports against their
+    # checked-in contracts. Drift count must be 0; on an environment that
+    # differs from the recorded one (contracts pin backend + device count)
+    # the check skips with CONTRACT_ENV_SKIPPED and the count stays honest.
+    contracts_dir = default_contracts_dir()
+    update = os.environ.get("BENCH_ANALYSIS_UPDATE_CONTRACTS") == "1"
+    gate_findings = gate_reports(
+        [bert_report, report], contracts_dir, update=update
+    )
+    result["analysis_contract_drift_count"] = drift_count(gate_findings)
+    result["analysis_contract_findings"] = [str(f) for f in gate_findings]
     return result
 
 

@@ -252,6 +252,158 @@ def test_collective_inventory_parses_both_ir_forms():
     assert collective_inventory(shlo)["reduce_scatter"] == {"count": 1, "bytes": 32}
 
 
+def test_collective_inventory_quantized_dtypes():
+    """The int8 serving path's collectives (s8/u8 in post-SPMD HLO, i8/ui8
+    in StableHLO) and sub-byte s4 must size correctly — a parser that only
+    knows float classes silently drops them from the inventory, and from
+    every contract built on it."""
+    hlo = "\n".join(
+        [
+            "  %ag = s8[8,256]{1,0} all-gather(s8[1,256]{1,0} %q), dimensions={0}",
+            "  %ar = u8[1024]{0} all-reduce(u8[1024]{0} %x), replica_groups={}",
+            "  %p = s4[4096]{0} all-gather(s4[512]{0} %w), dimensions={0}",
+        ]
+    )
+    inv = collective_inventory(hlo)
+    assert inv["all_gather"] == {"count": 2, "bytes": 8 * 256 + 4096 // 2}
+    assert inv["all_reduce"] == {"count": 1, "bytes": 1024}
+    shlo = '%2 = "stablehlo.all_gather"(%1) : (tensor<1x64xi8>) -> tensor<8x64xi8>'
+    assert collective_inventory(shlo)["all_gather"] == {"count": 1, "bytes": 512}
+
+
+def test_collective_inventory_async_start_forms():
+    """The overlap work's async spellings must inventory like their sync
+    forms: every `-start(` opcode counts once (the done is a different
+    opcode), sized from the tuple RESULT — first-type sizing would price an
+    all-gather at its (smaller) operand shape, and reduce-scatter/all-to-all
+    starts used to vanish entirely."""
+    hlo = "\n".join(
+        [
+            "  %ag = (f32[1024]{0}, f32[8192]{0}) all-gather-start(f32[1024]{0} %p), dimensions={0}",
+            "  %agd = f32[8192]{0} all-gather-done(f32[8192]{0} %ag)",
+            "  %rs = (f32[8192]{0}, f32[1024]{0}) reduce-scatter-start(f32[8192]{0} %q), dimensions={0}",
+            "  %rsd = f32[1024]{0} reduce-scatter-done(f32[1024]{0} %rs)",
+            "  %aa = (f32[2048]{0}, f32[2048]{0}) all-to-all-start(f32[2048]{0} %r)",
+        ]
+    )
+    inv = collective_inventory(hlo)
+    assert inv["all_gather"] == {"count": 1, "bytes": 8192 * 4}
+    # reduce-scatter's tuple is (operand, result): max = the 8192 operand —
+    # a deliberate over- not under-estimate; the schedule pass prices the
+    # matched done exactly
+    assert inv["reduce_scatter"] == {"count": 1, "bytes": 8192 * 4}
+    assert inv["all_to_all"] == {"count": 1, "bytes": 2048 * 4}
+
+
+def test_large_baked_constant_quantized_dtypes():
+    """A >=1MiB int8 table baked into a program (the int8 serving path's
+    dequant scales/tables) must trip LARGE_CONSTANT like a float one."""
+    from accelerate_tpu.analysis import constant_audit
+
+    hlo = "  %c = s8[2097152]{0} constant({...})"
+    findings = constant_audit(hlo, label="int8_const")
+    assert [f.code for f in findings] == ["LARGE_CONSTANT"]
+    assert findings[0].data["largest_bytes"] == 2 << 20
+    shlo = "  %c = stablehlo.constant dense_resource<w> : tensor<1048576x2xi8>"
+    findings = constant_audit(shlo, label="int8_const")
+    assert [f.code for f in findings] == ["LARGE_CONSTANT"]
+    assert findings[0].data["largest_bytes"] == 2 << 20
+    # sub-byte packing: 4M s4 elements are 2 MiB, not 4
+    sub = "  %c = s4[4194304]{0} constant({...})"
+    findings = constant_audit(sub, label="int4_const")
+    assert findings and findings[0].data["largest_bytes"] == 2 << 20
+
+
+def test_schedule_pass_classifies_overlap():
+    """Async pair with independent compute between start and done =
+    overlapped; async pair whose done is right behind the start (or a plain
+    sync collective) = serialized, its bytes on the critical path."""
+    from accelerate_tpu.analysis import collective_schedule
+
+    hlo = "\n".join(
+        [
+            "ENTRY %main {",
+            "  %p = f32[1024]{0} parameter(0)",
+            "  %q = f32[1024]{0} parameter(1)",
+            "  %ag = f32[8192]{0} all-gather-start(f32[1024]{0} %p), dimensions={0}",
+            "  %ind = f32[1024]{0} multiply(f32[1024]{0} %q, f32[1024]{0} %q)",
+            "  %agd = f32[8192]{0} all-gather-done(f32[8192]{0} %ag)",
+            "  %ar = f32[1024]{0} all-reduce-start(f32[1024]{0} %ind), to_apply=%add",
+            "  %ard = f32[1024]{0} all-reduce-done(f32[1024]{0} %ar)",
+            "  %sync = f32[512]{0} all-reduce(f32[512]{0} %q), to_apply=%add",
+            "}",
+        ]
+    )
+    s = collective_schedule(hlo)
+    assert s["total_count"] == 3 and s["async_count"] == 2
+    assert s["overlapped_count"] == 1  # the all-gather hid behind %ind
+    assert s["serialized_count"] == 2  # back-to-back all-reduce + the sync op
+    assert s["overlapped_comm_bytes"] == 8192 * 4
+    assert s["serialized_comm_bytes"] == 1024 * 4 + 512 * 4
+    per = s["per_kind"]
+    assert per["all_gather"]["overlapped_count"] == 1
+    assert per["all_reduce"]["serialized_bytes"] == 1024 * 4 + 512 * 4
+
+
+def test_schedule_pass_dependent_compute_is_not_overlap():
+    """Compute that CONSUMES the start's value (directly or transitively)
+    hides no latency — it must not count as overlap; nor do data-movement
+    ops like copy/reshape sitting between start and done."""
+    from accelerate_tpu.analysis import collective_schedule
+
+    hlo = "\n".join(
+        [
+            "ENTRY %main {",
+            "  %p = f32[1024]{0} parameter(0)",
+            "  %ag = f32[8192]{0} all-gather-start(f32[1024]{0} %p), dimensions={0}",
+            "  %use = f32[8192]{0} multiply(f32[8192]{0} %ag, f32[8192]{0} %ag)",
+            "  %chain = f32[8192]{0} add(f32[8192]{0} %use, f32[8192]{0} %use)",
+            "  %mv = f32[8192]{0} copy(f32[8192]{0} %p)",
+            "  %agd = f32[8192]{0} all-gather-done(f32[8192]{0} %ag)",
+            "}",
+        ]
+    )
+    s = collective_schedule(hlo)
+    assert s["overlapped_count"] == 0 and s["serialized_count"] == 1
+
+
+def test_schedule_pass_unmatched_done_is_serialized():
+    """An async start whose done the pass cannot pair (async-wrapped in a
+    different computation) must classify conservatively as SERIALIZED — the
+    walk saw the rest of the computation, not the start→done window, so
+    crediting 'overlap' would silently shrink the serialized-comm baseline."""
+    from accelerate_tpu.analysis import collective_schedule
+
+    hlo = "\n".join(
+        [
+            "ENTRY %main {",
+            "  %p = f32[1024]{0} parameter(0)",
+            "  %q = f32[1024]{0} parameter(1)",
+            "  %ag = f32[8192]{0} all-gather-start(f32[1024]{0} %p), dimensions={0}",
+            "  %ind = f32[1024]{0} multiply(f32[1024]{0} %q, f32[1024]{0} %q)",
+            "}",
+        ]
+    )
+    s = collective_schedule(hlo)
+    assert s["total_count"] == 1
+    assert s["overlapped_count"] == 0 and s["serialized_count"] == 1
+    assert s["serialized_comm_bytes"] == 8192 * 4  # sized from the start
+
+    # real XLA starts are tuple-typed (operand, result): the size must come
+    # from the LARGEST type in the result tuple, not the first (the input)
+    tup = "\n".join(
+        [
+            "ENTRY %main {",
+            "  %p = f32[1024]{0} parameter(0)",
+            "  %ag = (f32[1024]{0}, f32[8192]{0}) all-gather-start(f32[1024]{0} %p), dimensions={0}",
+            "}",
+        ]
+    )
+    s = collective_schedule(tup)
+    assert s["serialized_count"] == 1
+    assert s["serialized_comm_bytes"] == 8192 * 4
+
+
 def test_explain_recompile_names_the_leaf():
     a = signature_of(({"ids": jnp.ones((4, 8), jnp.int32), "n": 3},))
     b = signature_of(({"ids": jnp.ones((4, 12), jnp.int32), "n": 3},))
@@ -264,6 +416,23 @@ def test_explain_recompile_names_the_leaf():
         signature_of(({"n": 3},)), signature_of(({"n": 4},))
     )
     assert "static:3" in str(static["changed"])
+
+
+def test_explain_recompile_names_weak_type_flip():
+    """A Python-scalar-born array (weak dtype) and an explicit one share
+    shape AND dtype but are different trace keys — the signature must carry
+    the weak-type bit so the diff names the culprit leaf instead of
+    reporting "identical abstract signatures"."""
+    weak = jnp.asarray(1.0)  # Python float: weak f32
+    strong = jnp.float32(1.0) * jnp.ones(())  # committed f32
+    assert weak.aval.weak_type and not strong.aval.weak_type
+    a = signature_of(({"lr": weak},))
+    b = signature_of(({"lr": strong},))
+    assert a["0/lr"].endswith("/weak") and not b["0/lr"].endswith("/weak")
+    diff = explain_recompile(a, b)
+    assert list(diff["changed"]) == ["0/lr"]
+    assert "weak" in diff["summary"]
+    assert "identical" not in diff["summary"]
 
 
 def test_donation_drop_warning_branches():

@@ -1,0 +1,373 @@
+"""Program contracts: the differential regression gate (docs/analysis.md).
+
+Two halves, both acceptance criteria:
+
+1. **The self-gate** — every checked-in contract under ``tests/contracts``
+   must hold against the live repo: the CLI's ``--self-check --contracts``
+   mode (which builds exactly the canonical program set the contracts were
+   recorded from) exits 0 with zero drift.
+2. **The gate has teeth** — seeded regressions must each fail it with the
+   *specific* drifted-field finding: a step compiled with one extra
+   deliberate all-gather (`collectives.all_gather.count`), and one with
+   donation disabled (`donation.declared`). Plus the `--update-contracts`
+   round-trip invariant: update → clean check → byte-identical JSON on the
+   second update (contracts never churn when nothing drifted).
+
+Byte fields in contracts carry percentage tolerances precisely so this file
+can run on the CPU mesh without flaking on lowering differences; counts are
+exact by design — one new collective is one new collective.
+"""
+
+import json
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.analysis import (
+    ProgramContract,
+    audit_lowered,
+    drift_count,
+    gate_reports,
+)
+from accelerate_tpu.analysis.contracts import update_contract
+from accelerate_tpu.models import Bert
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTRACTS_DIR = os.path.join(REPO_ROOT, "tests", "contracts")
+
+# the programs the repo promises contracts for (ISSUE 8 acceptance): the
+# bert steps, the llama FSDP step, the paged decode, every prefill span of
+# the canonical self-check engine, and the bench-scale programs
+REQUIRED_CONTRACTS = {
+    "bert_tiny_step",
+    "llama_tiny_fsdp_step",
+    "serving_decode",
+    "serving_prefill_16",
+    "serving_prefill_32",
+    "serving_prefill_64",
+    "bert_base_step",
+    "llama_125m_fsdp_step",
+}
+
+
+def _bert_accelerator():
+    # the ONE canonical construction the bert_tiny_step contract is recorded
+    # from — shared with the CLI self-check so the seeded regressions below
+    # gate exactly the program the contract pins
+    from accelerate_tpu.commands.analyze import canonical_bert_program
+
+    return canonical_bert_program()
+
+
+# -- the self-gate (acceptance criterion) --------------------------------------
+
+
+def test_required_contracts_are_checked_in():
+    present = {
+        os.path.splitext(f)[0]
+        for f in os.listdir(CONTRACTS_DIR)
+        if f.endswith(".json")
+    }
+    missing = REQUIRED_CONTRACTS - present
+    assert not missing, f"contracts missing from tests/contracts: {sorted(missing)}"
+    # and every checked-in contract is loadable with the expected shape
+    for name in sorted(present):
+        contract = ProgramContract.load(os.path.join(CONTRACTS_DIR, f"{name}.json"))
+        assert contract.program == name
+        assert "max_errors" in contract.expectations
+        assert contract.env.get("backend")
+
+
+def test_self_gate_cli_contracts_pass_clean(capsys):
+    """`accelerate-tpu analyze --self-check --contracts` over the repo's own
+    checked-in contracts: zero drift, exit 0. This is the differential gate
+    every later PR (the ZeRO/overlap work first) must keep green or update
+    in a reviewed diff."""
+    from accelerate_tpu.commands.cli import main
+
+    rc = main(["analyze", "--self-check", "--contracts", "--contracts-dir", CONTRACTS_DIR])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "CONTRACT_DRIFT" not in out
+    assert "CONTRACT_MISSING" not in out
+
+
+# -- seeded regressions (the gate has teeth) -----------------------------------
+
+
+def test_seeded_extra_all_gather_fails_gate():
+    """One deliberate extra all-gather — a resharding constraint forcing a
+    replicated copy of a data-sharded activation, exactly the shape of a
+    sharding regression — must fail the bert contract with a finding naming
+    collectives.all_gather.count."""
+    accelerator, model, batch = _bert_accelerator()
+    base = Bert.loss_fn(model)
+    replicated = jax.sharding.NamedSharding(
+        accelerator.state.data_sharding().mesh, jax.sharding.PartitionSpec()
+    )
+
+    def loss_with_gather(params, b):
+        leak = jax.lax.with_sharding_constraint(
+            b["input_ids"].astype(jnp.float32), replicated
+        )
+        return base(params, b) + 0.0 * leak.sum()
+
+    report = accelerator.analyze(
+        loss_with_gather, batch, label="bert_tiny_step", write_record=False
+    )
+    findings = gate_reports([report], CONTRACTS_DIR)
+    assert drift_count(findings) >= 1, [str(f) for f in findings]
+    gather_drift = [
+        f
+        for f in findings
+        if f.code == "CONTRACT_DRIFT"
+        and f.data.get("field") == "collectives.all_gather.count"
+    ]
+    assert gather_drift, [str(f) for f in findings]
+    assert gather_drift[0].severity == "error"  # ERROR findings exit 1 in the CLI
+    assert gather_drift[0].data["expected"] == 0
+    assert gather_drift[0].data["actual"] >= 1
+    # the message names the expectation and the delta, for the PR author
+    assert "collectives.all_gather.count" in gather_drift[0].message
+    assert "expected 0" in gather_drift[0].message
+
+
+def test_seeded_dropped_donation_fails_gate():
+    """The same program compiled with donation off: the contract pins 76
+    donated-and-aliased buffers, so donation.declared/aliased both drift."""
+    accelerator, model, batch = _bert_accelerator()
+    step = accelerator.compiled_step(Bert.loss_fn(model), donate=False)
+    assert step.donate_argnums == ()
+    report = accelerator.analyze(
+        step=step, batch=batch, label="bert_tiny_step", write_record=False
+    )
+    findings = gate_reports([report], CONTRACTS_DIR)
+    drifted_fields = {
+        f.data.get("field") for f in findings if f.code == "CONTRACT_DRIFT"
+    }
+    assert "donation.declared" in drifted_fields, [str(f) for f in findings]
+    assert "donation.aliased" in drifted_fields
+    assert drift_count(findings) >= 2
+
+
+def test_gate_exits_1_on_tampered_contract(tmp_path, capsys):
+    """End-to-end CLI exit code: against a contracts dir whose bert contract
+    expects a donation count the live program cannot produce, the gate must
+    exit 1 and print the drifted field."""
+    import shutil
+
+    tampered_dir = tmp_path / "contracts"
+    shutil.copytree(CONTRACTS_DIR, tampered_dir)
+    path = tampered_dir / "bert_tiny_step.json"
+    payload = json.loads(path.read_text())
+    payload["expectations"]["donation"]["declared"] = 0
+    payload["expectations"]["donation"]["aliased"] = 0
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+    from accelerate_tpu.commands.cli import main
+
+    # --no-compile keeps this fast: donation declaration is a lowering-level
+    # property, so the tampered expectation still drifts without the AOT
+    # compile (the memory/schedule sections degrade to warnings by design)
+    rc = main(
+        ["analyze", "--self-check", "--no-compile", "--contracts",
+         "--contracts-dir", str(tampered_dir)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "donation.declared" in out
+    assert "CONTRACT_DRIFT" in out
+
+
+# -- update round-trip ---------------------------------------------------------
+
+
+def _tiny_report(label="tiny_prog"):
+    def f(state, x):
+        return state + x.sum(), state * 2.0
+
+    lowered = jax.jit(f, donate_argnums=(0,)).lower(
+        jnp.ones((32, 32)), jnp.ones((8,))
+    )
+    return audit_lowered(lowered, label=label)
+
+
+def test_update_contracts_round_trip(tmp_path):
+    """update → clean check → byte-identical JSON on the second update: the
+    churn-free invariant that keeps contract diffs reviewable."""
+    path = str(tmp_path / "tiny_prog.json")
+    report = _tiny_report()
+    assert update_contract(path, report) is True  # first write
+    first = open(path, "rb").read()
+
+    report2 = _tiny_report()  # fresh audit of the same program
+    contract = ProgramContract.load(path)
+    assert contract.check(report2) == []  # clean check between updates
+    assert update_contract(path, report2) is False  # nothing drifted: no rewrite
+    assert open(path, "rb").read() == first  # byte-identical
+
+    # and a genuinely drifted program rewrites the file
+    def g(state, x):
+        return state + x.sum(), state * 2.0
+
+    lowered = jax.jit(g).lower(jnp.ones((32, 32)), jnp.ones((8,)))  # no donation
+    drifted = audit_lowered(lowered, label="tiny_prog", expect_donation=False)
+    assert contract.check(drifted), "expected donation drift"
+    assert update_contract(path, drifted) is True
+    assert open(path, "rb").read() != first
+
+
+def test_sub_report_drift_gates_the_root_report(tmp_path):
+    """Drift in a merged sub-program (an engine prefill span, a fleet
+    replica) must surface on the ROOT report — the root's errors are what
+    the CLI exit code, the render, and the telemetry record read. merge()
+    copies findings BEFORE the gate runs, so the gate must bubble its own
+    findings up explicitly."""
+    parent = _tiny_report("parent_prog")
+    sub = _tiny_report("sub_prog")
+    parent.merge(sub, prefix="sub")
+    cdir = str(tmp_path)
+    gate_reports([parent], cdir, update=True)  # write both contracts
+
+    # tamper the SUB program's contract only
+    path = os.path.join(cdir, "sub_prog.json")
+    payload = json.loads(open(path).read())
+    payload["expectations"]["donation"]["declared"] = 9
+    open(path, "w").write(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+    fresh_parent = _tiny_report("parent_prog")
+    fresh_parent.merge(_tiny_report("sub_prog"), prefix="sub")
+    findings = gate_reports([fresh_parent], cdir)
+    assert drift_count(findings) == 1
+    root_drifts = [f for f in fresh_parent.errors if f.code == "CONTRACT_DRIFT"]
+    assert root_drifts, "sub-program drift never reached the root report"
+    assert root_drifts[0].data["field"] == "donation.declared"
+    # and a missing sub contract warns on the root too
+    os.remove(path)
+    fresh = _tiny_report("parent_prog")
+    fresh.merge(_tiny_report("sub_prog"), prefix="sub")
+    gate_reports([fresh], cdir)
+    assert any(f.code == "CONTRACT_MISSING" for f in fresh.findings)
+
+
+def test_lowered_only_report_degrades_compiled_contract_to_warning(tmp_path):
+    """A compiled contract checked against a lowered-only report must NOT
+    fabricate drift from the compiled-only sections (the pre-GSPMD
+    collective inventory is a different object than the executable's): one
+    WARNING names them unchecked, donation and errors still gate."""
+    path = str(tmp_path / "tiny_prog.json")
+    compiled_report = _tiny_report()
+    assert compiled_report.meta.get("compiled") is True
+    update_contract(path, compiled_report)
+    contract = ProgramContract.load(path)
+    assert contract.compiled
+
+    def f(state, x):
+        return state + x.sum(), state * 2.0
+
+    lowered_only = audit_lowered(
+        jax.jit(f, donate_argnums=(0,)).lower(jnp.ones((32, 32)), jnp.ones((8,))),
+        label="tiny_prog",
+        compile=False,
+    )
+    findings = contract.check(lowered_only)
+    assert drift_count(findings) == 0, [str(f) for f in findings]
+    warnings = [f for f in findings if f.severity == "warning"]
+    assert len(warnings) == 1 and warnings[0].data["field"] == "compiled"
+    # ...and an update from the lowered-only report REFUSES to clobber the
+    # compiled contract's sections it cannot re-derive
+    before = open(path, "rb").read()
+    assert update_contract(path, lowered_only) is False
+    assert open(path, "rb").read() == before
+
+
+def test_root_max_errors_excludes_sub_program_findings(tmp_path):
+    """A sub-program's ERROR (say a prefill span's FP64_LEAK) gates via the
+    SUB's contract; the root's max_errors check must not double-report it as
+    root drift — the author would be pointed at the wrong program."""
+    from accelerate_tpu.analysis import Finding
+
+    clean_parent = _tiny_report("parent_prog")
+    contract = ProgramContract.from_report(clean_parent)
+
+    parent = _tiny_report("parent_prog")
+    sub = _tiny_report("sub_prog")
+    sub.add(Finding("FP64_LEAK", "seeded", severity="error", path="sub_prog"))
+    parent.merge(sub, prefix="sub")
+    assert parent.errors  # the merge copied the sub's error up
+    findings = contract.check(parent)
+    assert not any(
+        f.data.get("field") == "errors" for f in findings
+    ), [str(f) for f in findings]
+    # while the sub's own contract still catches it
+    sub_contract = ProgramContract.from_report(_tiny_report("sub_prog"))
+    sub_findings = sub_contract.check(sub)
+    assert any(f.data.get("field") == "errors" for f in sub_findings)
+
+
+def test_update_refuses_section_loss(tmp_path):
+    """A same-env report that simply lacks a pinned section (backend without
+    memory_analysis) must not regenerate the contract — that would silently
+    delete the peak-HBM expectations from the gate."""
+    path = str(tmp_path / "tiny_prog.json")
+    update_contract(path, _tiny_report())
+    before = open(path, "rb").read()
+    stripped = _tiny_report()
+    stripped.inventory.pop("memory")
+    assert update_contract(path, stripped) is False
+    assert open(path, "rb").read() == before
+
+
+def test_update_refuses_env_mismatch(tmp_path):
+    """--update-contracts on the wrong environment must not silently rewrite
+    a contract recorded elsewhere (that would turn the CI gate off: every
+    check there would then CONTRACT_ENV_SKIPPED)."""
+    path = str(tmp_path / "tiny_prog.json")
+    report = _tiny_report()
+    update_contract(path, report)
+    contract = ProgramContract.load(path)
+    contract.env = {"backend": "tpu", "num_devices": 256}
+    contract.save(path)
+    before = open(path, "rb").read()
+    assert update_contract(path, report) is False
+    assert open(path, "rb").read() == before
+
+
+def test_contract_missing_and_env_skip(tmp_path):
+    report = _tiny_report()
+    # no contract checked in: the gate says so instead of passing silently
+    findings = gate_reports([report], str(tmp_path))
+    assert [f.code for f in findings] == ["CONTRACT_MISSING"]
+    assert findings[0].severity == "warning"
+
+    # a contract recorded on a different environment skips with INFO — it
+    # cannot distinguish drift from device-count arithmetic
+    contract = ProgramContract.from_report(report)
+    contract.env = {"backend": "tpu", "num_devices": 256}
+    skipped = contract.check(report)
+    assert [f.code for f in skipped] == ["CONTRACT_ENV_SKIPPED"]
+    assert skipped[0].severity == "info"
+
+
+def test_contract_byte_tolerance_scales():
+    report = _tiny_report()
+    contract = ProgramContract.from_report(report)
+    # push a byte expectation 50% off a value big enough to clear the 1 KiB
+    # slack floor: the default 25% tolerance drifts...
+    peak = report.inventory["memory"]["peak_hbm_bytes"]
+    assert peak > 2048, "tiny program too tiny for this test's arithmetic"
+    contract.expectations["memory"]["peak_hbm_bytes"] = int(peak * 1.5)
+    assert any(
+        f.data.get("field") == "memory.peak_hbm_bytes" for f in contract.check(report)
+    )
+    # ...but a tolerance-scaled check (how the CPU gate absorbs lowering
+    # differences) accepts it, while exact counts still never loosen
+    assert contract.check(report, tolerance_scale=2.0) == []
+    contract.expectations["donation"]["declared"] += 1
+    assert any(
+        f.data.get("field") == "donation.declared"
+        for f in contract.check(report, tolerance_scale=100.0)
+    )
