@@ -15,6 +15,16 @@ before touching a TPU.
 is SIGKILLed (router-side, deterministic step) mid-stream at the saturation
 point, and the report adds the failover accounting — every offered request
 must still terminate, goodput retained is printed against the healthy run.
+
+``--prefill-replicas N --decode-replicas M`` splits the fleet into
+disaggregated pools: prompts prefill on the N-pool, the live KV hands off
+page-by-page to the M-pool (docs/serving.md), and the report adds the
+handoff economy — handoffs adopted/fallbacks, pages and bytes moved,
+handoff p50/p99. The disaggregation drills
+(``--chaos handoff-stall|handoff-loss|prefill-kill``) stall or lose a
+transfer mid-flight, or SIGKILL a prefill replica with KV parked:
+terminated-exactly-once, fallback count, and goodput retained are the
+drill line.
 """
 
 from __future__ import annotations
@@ -45,14 +55,29 @@ def register_subcommand(subparsers):
         help="Engine replicas behind a health-aware router (1 = bare engine)",
     )
     parser.add_argument(
-        "--chaos", choices=["replica-kill", "replica-stall", "heartbeat-loss"],
+        "--prefill-replicas", type=int, default=0,
+        help="Disaggregated serving: replicas in the PREFILL pool (use with "
+             "--decode-replicas; overrides --replicas)",
+    )
+    parser.add_argument(
+        "--decode-replicas", type=int, default=0,
+        help="Disaggregated serving: replicas in the DECODE pool (prompts "
+             "prefill on the prefill pool, live KV hands off here)",
+    )
+    parser.add_argument(
+        "--chaos",
+        choices=["replica-kill", "replica-stall", "heartbeat-loss",
+                 "handoff-stall", "handoff-loss", "prefill-kill"],
         default=None,
         help="Fleet fault to inject mid-stream at the saturation point "
-             "(requires --replicas >= 2)",
+             "(replica faults need --replicas >= 2; handoff-*/prefill-kill "
+             "need --prefill-replicas/--decode-replicas)",
     )
     parser.add_argument(
         "--chaos-step", type=int, default=None,
-        help="Fleet step the fault fires at (default: max-new-tokens // 2)",
+        help="Fleet step the fault fires at (default: max-new-tokens // 2); "
+             "for handoff-stall/handoff-loss this is the handoff ATTEMPT "
+             "index (default: 0)",
     )
     parser.add_argument(
         "--mixed", action="store_true",
@@ -111,8 +136,25 @@ def run(args) -> int:
         run_offered_load,
     )
 
-    if args.chaos is not None and args.replicas < 2:
-        print(f"--chaos {args.chaos} needs --replicas >= 2 (a 1-replica fleet has no failover)")
+    disagg = args.prefill_replicas > 0 or args.decode_replicas > 0
+    if disagg and (args.prefill_replicas < 1 or args.decode_replicas < 1):
+        print("disaggregation needs BOTH --prefill-replicas >= 1 and --decode-replicas >= 1")
+        return 1
+    roles = (
+        ["prefill"] * args.prefill_replicas + ["decode"] * args.decode_replicas
+        if disagg
+        else None
+    )
+    n_replicas = len(roles) if disagg else args.replicas
+    if args.chaos in ("handoff-stall", "handoff-loss", "prefill-kill") and not disagg:
+        print(f"--chaos {args.chaos} drills the prefill/decode split — set "
+              "--prefill-replicas and --decode-replicas")
+        return 1
+    if args.chaos is not None and n_replicas < 2:
+        print(f"--chaos {args.chaos} needs >= 2 replicas (a 1-replica fleet has no failover)")
+        return 1
+    if disagg and args.no_paged:
+        print("disaggregated serving relays page-granular KV — drop --no-paged")
         return 1
 
     model = build_model(args.model)
@@ -166,21 +208,34 @@ def run(args) -> int:
         )
 
     def fresh_target(fault_plan=None):
-        if args.replicas == 1:
+        if n_replicas == 1 and not disagg:
             return fresh_engine()
+        kwargs = {}
+        if args.chaos == "handoff-stall" and fault_plan is not None:
+            # the stall drill only drills something if the stalled transfer
+            # overshoots the timeout — otherwise the attempt just runs 50ms
+            # late and adopts first try, reporting the ladder as exercised
+            # when nothing was tested
+            kwargs["handoff_timeout_s"] = fault_plan.stall_seconds / 2.0
         return ServingRouter(
-            engine_factory=fresh_engine, num_replicas=args.replicas,
-            fault_plan=fault_plan,
+            engine_factory=fresh_engine, num_replicas=n_replicas,
+            roles=roles, fault_plan=fault_plan, **kwargs,
         )
 
     def fleet_fault_plan():
         from ..resilience import FaultPlan
 
         step = args.chaos_step if args.chaos_step is not None else args.max_new_tokens // 2
+        attempt = args.chaos_step if args.chaos_step is not None else 0
         kwargs = {
-            "replica-kill": {"replica_kill_step": step, "replica_kill_index": args.replicas - 1},
-            "replica-stall": {"replica_stall_step": step, "replica_stall_index": args.replicas - 1},
-            "heartbeat-loss": {"heartbeat_loss_step": step, "heartbeat_loss_index": args.replicas - 1},
+            "replica-kill": {"replica_kill_step": step, "replica_kill_index": n_replicas - 1},
+            "replica-stall": {"replica_stall_step": step, "replica_stall_index": n_replicas - 1},
+            "heartbeat-loss": {"heartbeat_loss_step": step, "heartbeat_loss_index": n_replicas - 1},
+            # replica 0 is always a prefill-pool member (roles list the
+            # prefill pool first), so the kill lands where KV parks
+            "prefill-kill": {"replica_kill_step": step, "replica_kill_index": 0},
+            "handoff-stall": {"handoff_stall_at": (attempt,)},
+            "handoff-loss": {"handoff_loss_at": (attempt,)},
         }[args.chaos]
         return FaultPlan(seed=args.seed, **kwargs)
 
@@ -205,6 +260,7 @@ def run(args) -> int:
                 "chaos": args.chaos,
                 "replica_deaths": target.replica_deaths,
                 "failovers": target.failovers,
+                "kv_handoffs": getattr(target, "kv_handoffs", 0),
                 # every offered request must reach a terminal state — the
                 # loadgen's completed count IS the accounting check
                 "accounted": drill["requests_completed"],
@@ -226,7 +282,9 @@ def run(args) -> int:
         "max_len": max_len,
         "requests": args.requests,
         "max_new_tokens": args.max_new_tokens,
-        "replicas": args.replicas,
+        "replicas": n_replicas,
+        "prefill_replicas": args.prefill_replicas if disagg else None,
+        "decode_replicas": args.decode_replicas if disagg else None,
         "int8": bool(args.int8),
         "paged": not args.no_paged,
         "page_size": args.page_size if not args.no_paged else None,
@@ -246,7 +304,12 @@ def run(args) -> int:
     if args.json:
         print(json.dumps(payload))
         return 0
-    fleet = f", {args.replicas} replicas" if args.replicas > 1 else ""
+    if disagg:
+        fleet = f", {args.prefill_replicas} prefill + {args.decode_replicas} decode replicas"
+    elif n_replicas > 1:
+        fleet = f", {n_replicas} replicas"
+    else:
+        fleet = ""
     layout = (
         f"paged(page_size={args.page_size}"
         + (f", chunk={args.prefill_chunk}" if args.prefill_chunk else "")
@@ -267,7 +330,8 @@ def run(args) -> int:
     print(
         f"compiles: {payload['warmup_compile_count']} at warmup, "
         f"{payload['steady_state_compile_count']} after (steady state must be 0"
-        + (" — per replica" if args.replicas > 1 else "") + ")"
+        + (" — per pool" if disagg else (" — per replica" if n_replicas > 1 else ""))
+        + ")"
     )
     header = (
         f"{'offered req/s':>14} | {'tok/s':>9} | {'ttft p50':>9} | {'ttft p99':>9} | "
@@ -293,13 +357,29 @@ def run(args) -> int:
             f"{sat.get('prefill_chunks', 0)} prefill chunks, "
             f"{sat.get('cow_page_copies', 0)} COW copies"
         )
+    if disagg:
+        print(
+            f"handoff economy (saturation): {sat.get('handoffs_adopted', 0)} adopted / "
+            f"{sat.get('handoffs_retried', 0)} retried / "
+            f"{sat.get('handoff_fallbacks', 0)} fell back to re-prefill, "
+            f"{sat.get('handoff_pages_moved', 0)} pages "
+            f"({sat.get('handoff_bytes_moved', 0) / 1e6:.1f} MB) moved, "
+            f"handoff p50 {sat.get('handoff_p50_ms', 0):.1f}ms / "
+            f"p99 {sat.get('handoff_p99_ms', 0):.1f}ms"
+        )
     if drill is not None:
         retained = drill["goodput_retained"]
         print(
             f"chaos drill ({drill['chaos']}): {drill['requests_completed']}/"
-            f"{drill['offered_requests']} requests terminated, "
+            f"{drill['offered_requests']} requests terminated exactly once, "
             f"{drill['replica_deaths']} replica death(s), {drill['failovers']} failover(s), "
-            f"goodput retained "
+            + (
+                f"{drill.get('handoffs_adopted', 0)} handoff(s) adopted, "
+                f"{drill.get('handoff_fallbacks', 0)} fell back to re-prefill, "
+                if disagg
+                else ""
+            )
+            + "goodput retained "
             + (f"{retained:.2f}x vs healthy" if retained is not None else "n/a")
         )
     return 0

@@ -23,13 +23,22 @@ records in ``telemetry.jsonl``.
 from .chaos import FaultPlan
 from .guards import GuardPolicy, NumericalGuard, tree_all_finite, zero_guard_state
 from .hub import Resilience, ResilienceConfig
-from .retry import DEFAULT_IO_RETRY, FLEET_RETRY, RetryPolicy, is_fleet_transient
+from .retry import (
+    DEFAULT_IO_RETRY,
+    FLEET_RETRY,
+    HANDOFF_RETRY,
+    RetryPolicy,
+    is_fleet_transient,
+    is_handoff_transient,
+)
 
 __all__ = [
     "DEFAULT_IO_RETRY",
     "FLEET_RETRY",
+    "HANDOFF_RETRY",
     "FaultPlan",
     "is_fleet_transient",
+    "is_handoff_transient",
     "GuardPolicy",
     "NumericalGuard",
     "Resilience",

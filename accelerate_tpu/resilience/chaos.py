@@ -30,7 +30,15 @@ Fault legs:
   stalls for ``stall_seconds`` (straggler weather at fleet scale);
 - ``heartbeat_loss_step`` / ``heartbeat_loss_index`` — the chosen replica's
   heartbeat probe goes permanently silent: the process may be alive, but an
-  unreachable replica is operationally dead and the router must fail over.
+  unreachable replica is operationally dead and the router must fail over;
+- ``handoff_stall_at`` / ``handoff_loss_at`` — disaggregated-serving drills
+  over the router's live-KV handoff *attempts* (0-based attempt indices,
+  fleet-wide): a stalled attempt sleeps ``stall_seconds`` mid-transfer (slow
+  interconnect weather — with a ``handoff_timeout_s`` armed it reads as a
+  timeout), a lost one raises :class:`~..serving.fleet.HandoffLost` as if
+  the source's blocks vanished mid-read. Both must be absorbed by the
+  router's retry-then-re-prefill ladder without stranding or duplicating a
+  request.
 
 Activation: pass a plan to ``ResilienceConfig(fault_plan=...)`` /
 ``ServingEngine(fault_plan=...)``, or export ``ACCELERATE_CHAOS_*`` (see
@@ -85,6 +93,11 @@ class FaultPlan:
     replica_stall_index: int = 0
     heartbeat_loss_step: Optional[int] = None
     heartbeat_loss_index: int = 0
+    # handoff faults: indices count the router's live-KV handoff ATTEMPTS
+    # (0-based, fleet-wide — retries are attempts too, so (0, 1) drills a
+    # first failure AND its retry)
+    handoff_stall_at: tuple[int, ...] = ()
+    handoff_loss_at: tuple[int, ...] = ()
 
     # ledger of injected faults (appended in firing order); ``sink`` is set by
     # the resilience hub so every injection also lands in telemetry.jsonl
@@ -125,6 +138,8 @@ class FaultPlan:
             replica_stall_index=int(env.get("ACCELERATE_CHAOS_REPLICA_STALL_INDEX", "0")),
             heartbeat_loss_step=int(hb_step) if hb_step else None,
             heartbeat_loss_index=int(env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_INDEX", "0")),
+            handoff_stall_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_STALL_AT")),
+            handoff_loss_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_LOSS_AT")),
         )
 
     @property
@@ -138,6 +153,8 @@ class FaultPlan:
             or self.replica_kill_step is not None
             or self.replica_stall_step is not None
             or self.heartbeat_loss_step is not None
+            or self.handoff_stall_at
+            or self.handoff_loss_at
         )
 
     def _record(self, fault: str, **detail) -> None:
@@ -227,6 +244,25 @@ class FaultPlan:
             )
             return self.heartbeat_loss_index
         return None
+
+    def handoff_stall(self, attempt: int) -> Optional[float]:
+        """Seconds to stall handoff attempt ``attempt`` mid-transfer, or
+        None. Fires INSIDE the router's transfer (between the source read
+        and the destination adopt), so an armed ``handoff_timeout_s`` sees a
+        genuinely late transfer, not a mocked clock."""
+        if attempt in self.handoff_stall_at:
+            self._record("handoff_stall", attempt=attempt, seconds=self.stall_seconds)
+            return self.stall_seconds
+        return None
+
+    def handoff_loss(self, attempt: int) -> bool:
+        """Whether handoff attempt ``attempt`` loses its source blocks
+        mid-transfer (the router raises HandoffLost where the read would
+        have returned)."""
+        if attempt in self.handoff_loss_at:
+            self._record("handoff_loss", attempt=attempt)
+            return True
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -134,3 +134,44 @@ def is_fleet_transient(exception: Exception) -> bool:
 FLEET_RETRY = RetryPolicy(
     max_attempts=6, base_delay=0.05, max_delay=2.0, classify=is_fleet_transient
 )
+
+
+def is_handoff_transient(exception: Exception) -> bool:
+    """Classifier for live-KV handoff weather (disaggregated serving): a
+    transfer that timed out or lost its source mid-read
+    (:class:`~..serving.fleet.HandoffLost`), a destination with no free
+    lane/pages right now (``QueueFull``), or a replica dying underneath the
+    attempt (``ReplicaLost``) are all transient — the parked pages are
+    still refcounted at the source, so a later attempt re-reads the same
+    bits. A ``ValueError`` (incompatible pool geometry: page size/shape/
+    dtype mismatch) is fatal to the HANDOFF, never the request: the caller
+    skips the retries and degrades straight to re-prefill on the decode
+    pool.
+
+    Note the router does NOT spend retry budget on ``QueueFull``: it
+    catches that case before consulting this classifier and DEFERS the
+    handoff (parked KV waits for the next fleet step), because an in-step
+    backoff cannot free a pool that only frees by stepping. "Transient"
+    here means "safe to try again later", which for destination
+    backpressure is the next step, not the next sleep."""
+    from ..serving.fleet import HandoffLost, ReplicaLost
+    from ..serving.scheduler import QueueFull
+
+    if isinstance(exception, (HandoffLost, ReplicaLost, QueueFull)):
+        return True
+    return _default_classify(exception)
+
+
+# Handoff retries run INSIDE a router step while the source's pages sit
+# parked: short jittered backoffs (decorrelated, same argument as above) so
+# a transient blip is ridden out in milliseconds, and a genuinely lost
+# transfer falls back to re-prefill before the request's TTFT budget is
+# gone. The fallback — not the last retry — is the safety net. The router
+# applies this policy to TRANSFER failures only; destination QueueFull is
+# handled before it (deferred to the next fleet step, see
+# is_handoff_transient) — a caller reusing this policy via .call()/.wrap()
+# against a saturated pool would burn every attempt on a condition only a
+# fleet step can clear.
+HANDOFF_RETRY = RetryPolicy(
+    max_attempts=3, base_delay=0.01, max_delay=0.2, classify=is_handoff_transient
+)

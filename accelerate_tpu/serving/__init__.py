@@ -8,12 +8,23 @@ fleet layer (``router.py`` / ``fleet.py``): a health-aware
 :class:`ServingRouter` spreads load over N engine replicas behind the same
 ``submit/cancel/step/run`` surface, fails requests over when a replica dies,
 and folds the degradation ladder (shed → deadline-expire → quarantine)
-fleet-wide. Later serving work (paging, prefill/decode pools with live KV
-handoff, speculative decoding) builds on these pieces.
+fleet-wide. With per-replica ``roles=`` the fleet disaggregates into
+prefill and decode pools: prompts prefill on one pool, the live KV hands
+off page-by-page to the other (transactional, chaos-drilled, falling back
+to re-prefill), and TTFT stops competing with decode steps for the same
+chips. Later serving work (speculative decoding, multi-host serve meshes)
+builds on these pieces.
 """
 
 from .engine import ServingEngine, ServingResult, StepWatchdog, params_from_streamed
-from .fleet import EngineReplica, HealthPolicy, ReplicaLost, ReplicaState
+from .fleet import (
+    REPLICA_ROLES,
+    EngineReplica,
+    HandoffLost,
+    HealthPolicy,
+    ReplicaLost,
+    ReplicaState,
+)
 from .kv_cache import (
     SlotAllocator,
     SlotKVCache,
@@ -30,7 +41,9 @@ from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
 __all__ = [
     "ContinuousBatchingScheduler",
     "EngineReplica",
+    "HandoffLost",
     "HealthPolicy",
+    "REPLICA_ROLES",
     "PageAllocator",
     "PagedKVCache",
     "PrefixCache",

@@ -78,7 +78,10 @@ class ServingResult:
     request_id: int
     prompt: np.ndarray  # [S]
     generated: np.ndarray  # [<= max_new_tokens], ends with EOS when hit
-    finish_reason: str  # "eos" | "length" | "expired" | "cancelled" | "failed"
+    # "eos" | "length" | "expired" | "cancelled" | "failed" | "prefilled"
+    # ("prefilled" is not terminal to the FLEET: a prefill-pool engine parked
+    # the request's live KV for handoff and the router takes it from there)
+    finish_reason: str
     ttft_s: Optional[float]
     latency_s: Optional[float]
 
@@ -294,6 +297,10 @@ class ServingEngine:
         self._donation_checked = False  # one consult after the first compile
         self._draining = False  # drain(): stop admitting, finish active slots
         self._warming = False  # warmup(): synthetic prompts skip the prefix cache
+        # prefill-only requests whose finished KV awaits handoff: id → layout
+        # (pages still refcounted in the pool; lane already freed). The router
+        # acks adoption with release_parked(), or re-seats via resume_parked()
+        self._parked: dict[int, dict] = {}
 
     # -- jitted programs (dot-keyed: shared cache with generate()) ----------
 
@@ -504,6 +511,55 @@ class ServingEngine:
             build,
         )
 
+    def _page_extract_program(self):
+        """Read one page ``[L, page_size, KV, D]`` out of the pool — the
+        source half of a live-KV handoff between pools (arXiv:2112.01075:
+        the transfer moves ``len(pages)`` fixed-shape blocks, never a
+        ``max_len`` slab). Keyed only on the page shape, so any request's
+        extraction — whatever pages it holds — runs the same program:
+        handoffs happen in steady state and must compile nothing there
+        (warmup compiles this against the null page)."""
+
+        def build():
+            def extract(pk, pv, page):
+                return (
+                    jax.lax.dynamic_index_in_dim(pk, page, axis=1, keepdims=False),
+                    jax.lax.dynamic_index_in_dim(pv, page, axis=1, keepdims=False),
+                )
+
+            return jax.jit(extract)
+
+        return self._jit(
+            ("serve_page_extract", self.cache.num_pages, self.cache.page_size), build
+        )
+
+    def _page_insert_program(self):
+        """Write one transferred page block into the pool at ``page`` — the
+        adopt/copy program, the destination half of a live-KV handoff. The
+        page index rides as an int32 ARGUMENT (a baked index would both
+        recompile per page and trip ``analyze --self-check``'s constant
+        scan), so the shape key is only ``page_shape``: every adoption of
+        every request reuses one compiled program per pool, keeping
+        ``serving_steady_state_compile_count == 0`` under disaggregation."""
+
+        def build():
+            def insert(pk, pv, bk, bv, page):
+                pk = jax.lax.dynamic_update_index_in_dim(
+                    pk, bk.astype(pk.dtype), page, axis=1
+                )
+                pv = jax.lax.dynamic_update_index_in_dim(
+                    pv, bv.astype(pv.dtype), page, axis=1
+                )
+                return pk, pv
+
+            donate = (0, 1) if self._donate else ()
+            return jax.jit(insert, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_page_insert", self.cache.num_pages, self.cache.page_size, self._donate),
+            build,
+        )
+
     def _page_scrub_program(self):
         """Zero every page selected by a boolean mask — quarantine must scrub
         freed pages before the pool recycles them (masked attention weight is
@@ -568,6 +624,14 @@ class ServingEngine:
                         self.params, ids, self.cache.k, self.cache.v, row,
                         np.int32(0),
                     )
+                # the handoff pair (extract + adopt-insert) fires in steady
+                # state whenever this engine is a disaggregated pool member:
+                # compile both now against the null page (reading it is free,
+                # and re-inserting its own zeros changes nothing)
+                kb, vb = self.extract_pages([0])
+                self.cache.k, self.cache.v = self._page_insert_program()(
+                    self.cache.k, self.cache.v, kb[0], vb[0], np.int32(0)
+                )
         finally:
             self._warming = False
 
@@ -584,6 +648,7 @@ class ServingEngine:
         request_id: Optional[int] = None,
         submitted_at: Optional[float] = None,
         deadline_s: Optional[float] = None,
+        prefill_only: bool = False,
     ) -> int:
         """Enqueue one request; returns its id. Raises ``ValueError`` for
         prompts the engine can never serve (too long for the cache) and
@@ -596,12 +661,22 @@ class ServingEngine:
         arrival time so queue-full deferral shows up in TTFT instead of
         vanishing from it. ``deadline_s`` arms per-request expiry (relative
         to submission): a request past its deadline is retired — queued or
-        mid-decode — at the top of the next ``step()``."""
+        mid-decode — at the top of the next ``step()``.
+
+        ``prefill_only`` is the disaggregated-serving intake (router.py):
+        the engine runs the prompt's prefill (chunked as usual) and then
+        PARKS the finished KV — lane freed, pages refcounted — emitting a
+        ``"prefilled"`` result instead of decoding. The router relays the
+        parked pages to a decode-pool replica via ``adopt_kv`` and acks with
+        ``release_parked``. Paged engines only: the dense slab has no
+        page-granular layout to relay."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prefill_only and not self.paged:
+            raise ValueError("prefill_only serving needs a paged engine (paged=True)")
         prefill_len = prompt.size - 1
         if prefill_len > max(self.buckets):
             raise ValueError(
@@ -666,6 +741,7 @@ class ServingEngine:
                 queue_depth=e.queue_depth,
                 retry_after_s=hint,
             ) from None
+        request.prefill_only = prefill_only
         self.stats.record_submit()
         return request.id
 
@@ -858,7 +934,8 @@ class ServingEngine:
         long prompts spread over the step cadence, so already-admitted
         requests keep decoding every step instead of stalling behind a
         monolithic prefill; without ``prefill_chunk`` the single span
-        completes immediately). Returns requests failed by page pressure."""
+        completes immediately). Returns requests failed by page pressure plus
+        the ``"prefilled"`` results of parked prefill-only requests."""
         failed: list[ServingResult] = []
         for slot in list(self.scheduler.active_slots):
             request = self.scheduler.slots[slot]
@@ -867,7 +944,9 @@ class ServingEngine:
             prefill_len = request.prompt.size - 1
             remaining = prefill_len - request.prefilled
             if remaining <= 0:
-                self._finish_prefill(slot, request)
+                parked = self._finish_prefill(slot, request)
+                if parked is not None:
+                    failed.append(parked)
                 continue
             span = self._next_span(remaining, request.prefilled)
             # pages for this span beyond what admission / earlier chunks
@@ -896,21 +975,34 @@ class ServingEngine:
             chunked_span = not self._warming and (
                 take < remaining or request.prefilled > request.prefix_hit
             )
+            # the table ROW is copied at dispatch: jax's CPU H2D is zero-copy,
+            # so handing the program a live view of `tables` races host-side
+            # mutation (park/retire zero the row right after this dispatch,
+            # with no same-step decode fence in between) against XLA's read —
+            # the prefill would scatter into the null page and silently lose
+            # the request's KV
             self.cache.k, self.cache.v = self._paged_prefill_program(span)(
                 self.params, ids, self.cache.k, self.cache.v,
-                self.cache.tables[slot], np.int32(request.prefilled),
+                self.cache.tables[slot].copy(), np.int32(request.prefilled),
             )
             request.prefilled += take
             self.stats.record_prefill(span)
             if chunked_span:
                 self.stats.record_prefill_chunk()
             if request.prefilled >= prefill_len:
-                self._finish_prefill(slot, request)
+                parked = self._finish_prefill(slot, request)
+                if parked is not None:
+                    failed.append(parked)
         return failed
 
-    def _finish_prefill(self, slot: int, request: Request) -> None:
+    def _finish_prefill(self, slot: int, request: Request) -> Optional[ServingResult]:
         """Every prompt token is in cache pages: register the aligned prefix
-        for future sharers and make the slot decode-visible."""
+        for future sharers and make the slot decode-visible — or, for a
+        ``prefill_only`` request, PARK the finished KV for handoff: the lane
+        frees immediately (the next prefill admits this very step's sweep)
+        while the pages stay refcounted until the router acks adoption
+        (``release_parked``) or re-seats locally (``resume_parked``).
+        Returns the parked request's ``"prefilled"`` result, else None."""
         prefill_len = request.prompt.size - 1
         if self.prefix_sharing and not self._warming:
             blocks = prefill_len // self.cache.page_size
@@ -919,9 +1011,27 @@ class ServingEngine:
                     request.prompt[: blocks * self.cache.page_size],
                     self.cache.tables[slot, :blocks],
                 )
+        if request.prefill_only:
+            pages = self.cache.park(slot)
+            self._parked[request.id] = {
+                "pages": pages,
+                "page_size": self.cache.page_size,
+                "length": prefill_len,
+                "last_token": int(request.prompt[-1]),
+                "page_shape": self._page_shape(),
+                "dtype": str(self.cache.dtype),
+            }
+            self._pending[slot] = 0
+            done = self.scheduler.retire(slot, "prefilled")
+            self.stats.record_parked()
+            self._resilience(
+                {"event": "prefilled", "request_id": done.id, "pages": len(pages)}
+            )
+            return self._result_for(done)
         self.cache.lengths[slot] = prefill_len
         self.cache.active[slot] = True
         self._pending[slot] = request.prompt[-1]
+        return None
 
     def _preempt_slot(self, slot: int, reason: str) -> None:
         """Recompute-style eviction: back to the queue head, pages freed."""
@@ -1335,17 +1445,35 @@ class ServingEngine:
             keys,
         )
 
+    def _page_shape(self) -> tuple:
+        """One page's block shape ``[L, page_size, KV, D]`` — the fixed unit
+        a handoff transfers, and the only shape the extract/insert programs
+        are keyed on."""
+        return tuple(
+            int(d) for i, d in enumerate(self.cache.k.shape) if i != 1
+        )
+
+    @property
+    def parked_count(self) -> int:
+        """Prefill-only requests whose finished KV awaits handoff here."""
+        return len(self._parked)
+
     def kv_page_layout(self, request_id: int) -> Optional[dict]:
-        """The page-granular layout of one in-flight request's live KV — the
-        concrete payload a prefill/decode-pool handoff relays through
+        """The page-granular layout of one request's live KV — the concrete
+        payload a prefill/decode-pool handoff relays through
         :meth:`~.router.ServingRouter._kv_handoff` (arXiv:2112.01075: moving
         a request's cache between pools is an array-redistribution problem,
         and this dict is its source description: which physical pages, in
         what order, holding how many valid positions, in what per-page
-        shape). None when the engine is unpaged or the request holds no
-        pages here."""
+        shape). A PARKED request (prefill finished, awaiting adoption) is
+        the transferable case — its dict carries ``parked: True`` and the
+        ``last_token`` the destination decodes first. None when the engine
+        is unpaged or the request holds no pages here."""
         if not self.paged:
             return None
+        parked = self._parked.get(request_id)
+        if parked is not None:
+            return {"slot": None, "parked": True, **parked}
         for slot, request in enumerate(self.scheduler.slots):
             if request is None or request.id != request_id:
                 continue
@@ -1358,12 +1486,192 @@ class ServingEngine:
                 "page_size": self.cache.page_size,
                 "length": int(self.cache.lengths[slot]),
                 "prefilled": request.prefilled,
-                "page_shape": tuple(
-                    int(d) for i, d in enumerate(self.cache.k.shape) if i != 1
-                ),
+                "page_shape": self._page_shape(),
                 "dtype": str(self.cache.dtype),
             }
         return None
+
+    def extract_pages(self, pages: Sequence[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Host copies of ``pages``' K/V blocks, ``[n, L, page_size, KV, D]``
+        each — the device→host half of a handoff. One fixed-shape jitted
+        read per page (shape keyed on ``page_shape`` only), so extraction
+        never compiles in steady state whatever set of pages moves. All n
+        reads dispatch before the first host copy blocks, so the transfers
+        pipeline instead of paying n serialized round-trips."""
+        program = self._page_extract_program()
+        out = [program(self.cache.k, self.cache.v, np.int32(page)) for page in pages]
+        return (
+            np.stack([np.asarray(k1) for k1, _ in out]),
+            np.stack([np.asarray(v1) for _, v1 in out]),
+        )
+
+    def adopt_kv(
+        self,
+        prompt,
+        max_new_tokens: int,
+        layout: dict,
+        k_blocks: np.ndarray,
+        v_blocks: np.ndarray,
+        request_id: Optional[int] = None,
+        submitted_at: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Adopt a request whose prefill ran on ANOTHER engine: allocate a
+        lane + pages, insert the transferred fixed-shape blocks through the
+        jitted per-page copy program, and take over scheduling from the
+        exact position the source parked — the destination half of the
+        live-KV handoff, replacing re-prefill.
+
+        Token-exact by checked construction: ``layout["length"]`` must equal
+        ``len(prompt) - 1`` (every prompt position is in the transferred
+        pages; the first decode input is the prompt's last token, whose
+        logits are the request's FIRST token — so no token is ever computed
+        twice and none is skipped). Incompatible layouts (page size/shape/
+        dtype mismatch — different pool geometry) raise ``ValueError``
+        (fatal: a retry cannot fix it); exhausted lanes/pages raise
+        :class:`QueueFull` (transient: the router retries or falls back to
+        re-prefill). Returns the adopted request id."""
+        if not self.paged:
+            raise ValueError("adopt_kv needs a paged engine (paged=True)")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        length = int(layout["length"])
+        n = len(k_blocks)
+        if length != prompt.size - 1:
+            raise ValueError(
+                f"adoption is not token-exact: layout holds {length} positions "
+                f"but the prompt prefills {prompt.size - 1}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if n < 1 or n != len(v_blocks):
+            raise ValueError(f"got {n} k-blocks / {len(v_blocks)} v-blocks")
+        if int(layout["page_size"]) != self.cache.page_size:
+            raise ValueError(
+                f"page_size mismatch: source {layout['page_size']}, "
+                f"this pool {self.cache.page_size}"
+            )
+        if tuple(layout["page_shape"]) != self._page_shape():
+            raise ValueError(
+                f"page_shape mismatch: source {tuple(layout['page_shape'])}, "
+                f"this pool {self._page_shape()}"
+            )
+        if str(layout.get("dtype", self.cache.dtype)) != str(self.cache.dtype):
+            raise ValueError(
+                f"dtype mismatch: source {layout['dtype']}, this pool {self.cache.dtype}"
+            )
+        need = max(n, pages_for(length + max_new_tokens, self.cache.page_size))
+        if n > self.cache.pages_per_slot or need > self.cache.num_pages - 1:
+            raise ValueError(
+                f"adopted request needs {need} pages but the pool holds "
+                f"{self.cache.num_pages - 1} ({self.cache.pages_per_slot} per slot)"
+            )
+        if length + max_new_tokens > self.cache.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the slot capacity max_len={self.cache.max_len}"
+            )
+        if self._draining:
+            raise QueueFull(
+                "engine is draining — not adopting new requests",
+                queue_depth=self.scheduler.waiting,
+                retry_after_s=self.retry_after_hint(),
+            )
+        fresh = self.cache._alloc(n)
+        if fresh is None:
+            raise QueueFull(
+                f"page pool cannot hold {n} adopted pages right now",
+                queue_depth=self.scheduler.waiting,
+                retry_after_s=self.retry_after_hint(),
+            )
+        slot = self.cache.seat(fresh, length)
+        if slot is None:
+            for page in fresh:
+                self.cache.pages.decref(page)
+            raise QueueFull(
+                "no free lane for the adopted request",
+                queue_depth=self.scheduler.waiting,
+                retry_after_s=self.retry_after_hint(),
+            )
+        program = self._page_insert_program()
+        for dst, bk, bv in zip(fresh, k_blocks, v_blocks):
+            self.cache.k, self.cache.v = program(
+                self.cache.k, self.cache.v, bk, bv, np.int32(dst)
+            )
+        request = Request(
+            id=request_id if request_id is not None else next(self.scheduler._ids),
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s,
+        )
+        if submitted_at is not None:
+            request.submitted_at = submitted_at
+        request.prefilled = length
+        self.scheduler.adopt(request, slot)
+        self._pending[slot] = prompt[-1]
+        self.stats.record_adopted()
+        return request.id
+
+    def can_adopt(self, n_pages: int) -> bool:
+        """Cheap capacity pre-check for a handoff destination: a free lane
+        and plausibly enough pages (registry-only prefix entries count as
+        reclaimable — ``_alloc`` evicts them under pressure). A False lets
+        the router DEFER the handoff — parked KV waits at the source for the
+        next fleet step — instead of burning transfer work (or its retry
+        budget) against a saturated pool."""
+        if self._draining or not self.paged:
+            return False
+        if self.cache.lanes.free_count == 0:
+            return False
+        return self.cache.pages.free_count + len(self.cache.prefix) >= n_pages
+
+    def release_parked(self, request_id: int) -> bool:
+        """Ack one parked handoff: drop the source-side page references (the
+        destination adopted the content, or the fallback re-prefills it
+        elsewhere). Registered prefix pages survive through the registry's
+        own reference, exactly as in :meth:`~.paging.PagedKVCache.retire`.
+        Returns whether the id was parked here."""
+        parked = self._parked.pop(request_id, None)
+        if parked is None:
+            return False
+        for page in parked["pages"]:
+            self.cache.pages.decref(page)
+        return True
+
+    def resume_parked(
+        self,
+        request_id: int,
+        prompt,
+        max_new_tokens: int,
+        submitted_at: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ) -> bool:
+        """Re-seat a parked request on THIS engine with zero copies — the
+        src == dst degenerate handoff (the decode pool vanished, this
+        replica went mixed, and the parked pages are already in its own
+        pool): claim a lane, point its table row back at the parked pages,
+        and decode. False when no lane is free (stays parked; the router
+        retries next step) or the id is not parked here."""
+        parked = self._parked.get(request_id)
+        if parked is None:
+            return False
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        slot = self.cache.seat(parked["pages"], parked["length"])
+        if slot is None:
+            return False
+        self._parked.pop(request_id)
+        request = Request(
+            id=request_id,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s,
+        )
+        if submitted_at is not None:
+            request.submitted_at = submitted_at
+        request.prefilled = parked["length"]
+        self.scheduler.adopt(request, slot)
+        self._pending[slot] = prompt[-1]
+        self.stats.record_adopted()
+        return True
 
     def _consult_donation(self) -> None:
         """Lowering-level check: catches donations dropped at trace time (no
@@ -1465,6 +1773,27 @@ class ServingEngine:
                     **audit_kwargs,
                 )
                 report.merge(sub, prefix=f"prefill_{bucket}")
+            if self.paged:
+                # the adopt/copy program (disaggregated handoff destination):
+                # donation must stay intact and the page index must ride as
+                # an argument — a baked page-table constant here would both
+                # recompile per adoption and bloat the program
+                shape = self._page_shape()
+                lowered = self._page_insert_program().lower(
+                    self.cache.k,
+                    self.cache.v,
+                    jax.ShapeDtypeStruct(shape, self.cache.k.dtype),
+                    jax.ShapeDtypeStruct(shape, self.cache.v.dtype),
+                    np.int32(0),
+                )
+                sub = audit_lowered(
+                    lowered,
+                    compile=False,
+                    label="serving_adopt_kv",
+                    expect_donation=self._donate,
+                    **audit_kwargs,
+                )
+                report.merge(sub, prefix="adopt_kv")
         if contracts_dir is not None:
             from ..analysis.contracts import gate_reports
 

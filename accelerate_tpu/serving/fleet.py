@@ -46,12 +46,35 @@ class ReplicaLost(RuntimeError):
         self.replica_index = replica_index
 
 
+class HandoffLost(RuntimeError):
+    """A live-KV handoff attempt failed in flight: the transfer timed out,
+    the source's blocks vanished mid-read (chaos ``handoff_loss``, or the
+    source replica died between park and adoption), or the destination
+    raised before acknowledging. Classified transient by
+    :func:`~..resilience.retry.is_handoff_transient` — the router retries
+    under a jittered policy and then degrades to re-prefill on the decode
+    pool, which is always correct: a parked request has delivered ZERO
+    tokens, so regeneration from the prompt can neither duplicate nor skip
+    one."""
+
+
 class ReplicaState(str, enum.Enum):
     HEALTHY = "healthy"
     DEGRADED = "degraded"
     DRAINING = "draining"
     DEAD = "dead"
     RECOVERING = "recovering"
+
+
+# Disaggregated serving (docs/serving.md): a replica's ROLE names which
+# request phases it serves. "mixed" (the default) is the replicated baseline
+# — prefill and decode on the same chips. A "prefill" replica runs prompt
+# prefills and parks the finished KV for handoff; a "decode" replica adopts
+# handed-off KV (or re-prefills on fallback) and streams tokens. Roles are
+# an OPERATIONAL property, not a health state: the router demotes a pool's
+# survivors to "mixed" when the opposite pool dies, so the fleet keeps
+# serving — slower — with either pool gone.
+REPLICA_ROLES = ("prefill", "decode", "mixed")
 
 
 @dataclass(frozen=True)
@@ -82,11 +105,15 @@ class EngineReplica:
         engine: Any,
         policy: Optional[HealthPolicy] = None,
         on_transition: Optional[Callable[["EngineReplica", ReplicaState, str], None]] = None,
+        role: str = "mixed",
     ):
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"role must be one of {REPLICA_ROLES}, got {role!r}")
         self.index = index
         self.engine = engine
         self.policy = policy or HealthPolicy()
         self.on_transition = on_transition
+        self.role = role
         self.state = ReplicaState.HEALTHY
         self.last_progress = time.monotonic()
         self.death_reason: Optional[str] = None
@@ -112,6 +139,16 @@ class EngineReplica:
             self.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
             and not self.engine.draining
         )
+
+    @property
+    def serves_prefill(self) -> bool:
+        """This replica runs new prompts' prefills ("mixed" serves both)."""
+        return self.role in ("prefill", "mixed")
+
+    @property
+    def serves_decode(self) -> bool:
+        """This replica decodes (adopting handed-off KV, or full serving)."""
+        return self.role in ("decode", "mixed")
 
     def load_score(self) -> float:
         """Live load from the engine's own books: waiting requests plus
@@ -234,6 +271,7 @@ class EngineReplica:
         return {
             "index": self.index,
             "state": self.state.value,
+            "role": self.role,
             "load_score": round(self.load_score(), 4) if self.alive else None,
             "degraded_events": self._degraded_events,
             "death_reason": self.death_reason,

@@ -417,6 +417,39 @@ class PagedKVCache:
         self.active[slot] = False
         return freed
 
+    def park(self, slot: int) -> list[int]:
+        """Detach a slot's pages WITHOUT dropping their references: the lane
+        frees (it can admit the next prefill immediately) but every page keeps
+        the refcount this slot held, so the allocator cannot recycle them.
+        This is the source half of a live-KV handoff (docs/serving.md): the
+        parked pages stay readable — and exactly as shared/registered as they
+        were — until the destination acknowledges adoption (the caller then
+        decrefs each parked page, mirroring :meth:`retire`) or the handoff
+        falls back (same release; re-prefill regenerates the content).
+        Returns the parked pages in position order."""
+        pages = self.pages_of(slot)
+        self.lanes.retire(slot)
+        self.tables[slot, :] = 0
+        self.held[slot] = 0
+        self.lengths[slot] = 0
+        self.active[slot] = False
+        return pages
+
+    def seat(self, pages: Sequence[int], length: int) -> Optional[int]:
+        """Claim a lane for pages the caller already owns (freshly allocated
+        by ``adopt_kv``, or a parked row being resumed in place) and make it
+        decode-visible at ``length``. Returns the slot, or None when no lane
+        is free — the caller keeps its page references and retries later."""
+        slot = self.lanes.admit()
+        if slot is None:
+            return None
+        self.tables[slot, : len(pages)] = list(pages)
+        self.tables[slot, len(pages):] = 0
+        self.held[slot] = len(pages)
+        self.lengths[slot] = length
+        self.active[slot] = True
+        return slot
+
     def retire(self, slot: int) -> None:
         """Free the lane and the slot's page references. Registered prefix
         pages survive through the registry's own reference; everything else

@@ -18,8 +18,7 @@ one replica from eight — until one dies, which is the point:
   (SIGKILL semantics: that memory is gone). Recovery re-prefills from the
   prompt — correct by construction, since at temperature 0 the regenerated
   tokens are bit-identical and at temperature > 0 no token was ever
-  delivered twice. :meth:`_kv_handoff` is the seam where a future
-  arXiv:2112.01075-style live-KV relayout slots in;
+  delivered twice;
 - **backpressure** composes: overload on one replica drains to the others
   before ``QueueFull`` ever reaches the caller; only when every placeable
   replica is full does the router shed, quoting the *minimum*
@@ -27,6 +26,30 @@ one replica from eight — until one dies, which is the point:
 - **degradation** is fleet-wide: the PR-4 ladder (shed → deadline-expire →
   quarantine) keeps running per engine, and the health state machine
   (:mod:`~.fleet`) folds those per-replica events into placement decisions.
+
+**Disaggregated prefill/decode pools** (``roles=``): replicas may be tagged
+``prefill`` / ``decode`` / ``mixed`` (default ``mixed`` = the replicated
+baseline above). A new request is admitted onto a prefill-pool replica with
+``prefill_only=True``: the engine runs the prompt's (chunked) prefill and
+PARKS the finished KV — and the router then **hands the live cache to a
+decode replica** through :meth:`_kv_handoff` instead of re-prefilling.
+PR 7's ``kv_page_layout`` made the source side fixed-shape pages, so the
+transfer is exactly the array-redistribution problem of arXiv:2112.01075 —
+``len(pages)`` fixed blocks move through one jitted per-page extract/insert
+program pair (shapes keyed only on ``page_shape``), never a ``max_len``
+slab, and ``serving_steady_state_compile_count == 0`` survives per pool.
+Every handoff is **transactional**: the source's pages stay refcounted
+until the destination acknowledges token-exact adoption (``adopt_kv``
+verifies the parked length covers exactly the prompt's prefill — the first
+decode input is the prompt's last token, so no token is ever produced
+twice or skipped). The failure ladder — timeout, ``HandoffLost``,
+mid-transfer source death, destination ``QueueFull`` — retries under a
+jittered :data:`~..resilience.retry.HANDOFF_RETRY` and then **degrades to
+re-prefill on the decode pool** (a parked request has delivered zero
+tokens, so re-prefill can neither duplicate nor strand). And the pools
+degrade gracefully: when the last prefill-capable replica dies or drains,
+the decode survivors are promoted to ``mixed`` (and vice versa) — the
+fleet keeps serving, slower, with either pool gone.
 
 Every replica runs the same fixed-shape programs as a lone engine —
 replication never costs a recompile (the GSPMD argument, arXiv:2105.04663:
@@ -46,7 +69,7 @@ import numpy as np
 
 from ..telemetry.serving import fleet_rollup
 from .engine import ServingEngine, ServingResult, generation_row
-from .fleet import EngineReplica, HealthPolicy, ReplicaLost, ReplicaState
+from .fleet import EngineReplica, HandoffLost, HealthPolicy, ReplicaLost, ReplicaState
 from .scheduler import QueueFull
 
 # Router request ids live far above any engine-internal id (engine schedulers
@@ -69,7 +92,20 @@ class RoutedRequest:
     deadline_s: Optional[float]
     submitted_at: float
     replica: Optional[int] = None  # index hosting it; None = router-pending
-    last_replica: Optional[int] = None  # previous host (KV-handoff source)
+    last_replica: Optional[int] = None  # previous host
+    # which capability the NEXT placement needs: "prefill" until the prompt's
+    # KV exists somewhere, "decode" once a prefill-pool replica parked it
+    # (or a fallback re-prefill is heading for the decode pool)
+    phase: str = "prefill"
+    # replica index holding this request's PARKED live KV (refcounted there
+    # until the handoff acks or falls back); None = nothing to relay
+    kv_source: Optional[int] = None
+    # handoff retry state: failed attempts so far, and the jittered-backoff
+    # stamp before which the router must NOT retry — the backoff is a time
+    # GATE on the per-step re-offer, never an in-step sleep (a sleep inside
+    # step() would stall decode on every replica fleet-wide)
+    handoff_attempts: int = 0
+    handoff_retry_at: Optional[float] = None
     failovers: int = 0
     cancelled: bool = False
 
@@ -89,10 +125,13 @@ class ServingRouter:
         *,
         engine_factory: Optional[Any] = None,
         num_replicas: Optional[int] = None,
+        roles: Optional[Sequence[str]] = None,
         health: Optional[HealthPolicy] = None,
         telemetry: Any = None,
         fault_plan: Any = None,
         max_failovers: int = 2,
+        handoff_timeout_s: Optional[float] = 5.0,
+        handoff_retry: Any = None,
     ):
         if engines is None:
             if engine_factory is None or num_replicas is None:
@@ -110,6 +149,12 @@ class ServingRouter:
             fault_plan = _chaos_mod.active_plan()
         self.chaos = fault_plan
         self.max_failovers = max_failovers
+        if roles is None:
+            roles = ["mixed"] * len(engines)
+        elif len(roles) != len(engines):
+            raise ValueError(
+                f"roles= names {len(roles)} replicas but the fleet has {len(engines)}"
+            )
         self.replicas = []
         for i, engine in enumerate(engines):
             if engine.name is None:
@@ -117,8 +162,36 @@ class ServingRouter:
             if engine.telemetry is None and telemetry is not None:
                 engine.telemetry = telemetry
             self.replicas.append(
-                EngineReplica(i, engine, policy=health, on_transition=self._on_transition)
+                EngineReplica(
+                    i, engine, policy=health, on_transition=self._on_transition,
+                    role=roles[i],
+                )
             )
+        # disaggregated = any non-mixed role was CONFIGURED; pool-loss
+        # degradation may later demote survivors to mixed, but the fleet
+        # stays "disaggregated" in the sense that matters (handoff machinery
+        # armed, per-pool telemetry labeled)
+        self.disaggregated = any(r.role != "mixed" for r in self.replicas)
+        if self.disaggregated:
+            if not any(r.serves_prefill for r in self.replicas) or not any(
+                r.serves_decode for r in self.replicas
+            ):
+                raise ValueError(
+                    "disaggregated roles need at least one prefill-capable and "
+                    "one decode-capable replica (mixed counts as both)"
+                )
+            dense = [i for i, r in enumerate(self.replicas) if not r.engine.paged]
+            if dense:
+                raise ValueError(
+                    f"disaggregated serving relays page-granular KV — replicas "
+                    f"{dense} run the dense slab (paged=False) and cannot hand off"
+                )
+        if handoff_retry is None:
+            from ..resilience.retry import HANDOFF_RETRY
+
+            handoff_retry = HANDOFF_RETRY
+        self.handoff_retry = handoff_retry
+        self.handoff_timeout_s = handoff_timeout_s
         self._ids = itertools.count(_ROUTER_ID_BASE)
         self._inflight: dict[int, RoutedRequest] = {}
         self._pending: list[RoutedRequest] = []  # awaiting (re-)placement
@@ -131,6 +204,9 @@ class ServingRouter:
         self.failed_failovers = 0
         self.rehomed = 0
         self.replica_deaths = 0
+        self.kv_handoffs = 0  # adopted live-KV handoffs (per-replica economy
+        # counters live on the engines' ServingStats; this is the router view)
+        self._handoff_attempt_seq = 0  # fleet-wide attempt index (chaos hooks)
         self.placements = [0] * len(self.replicas)
 
     # -- the single-engine surface ------------------------------------------
@@ -154,7 +230,7 @@ class ServingRouter:
             deadline_s=deadline_s,
             submitted_at=submitted_at if submitted_at is not None else time.perf_counter(),
         )
-        candidates = self._placement_order()
+        candidates = self._placement_order("prefill")
         if not candidates:
             alive = [r for r in self.replicas if r.alive]
             if not alive:
@@ -179,13 +255,16 @@ class ServingRouter:
                 continue
             # ValueError (prompt the fleet can never serve) propagates —
             # every replica shares one shape config, so the first verdict
-            # is the fleet's verdict
+            # is the fleet's verdict. A prefill-POOL replica runs the
+            # prompt's prefill and parks the KV for handoff; a mixed
+            # replica serves the request end to end (the baseline path).
             replica.engine.submit(
                 rr.prompt,
                 rr.max_new_tokens,
                 request_id=rr.id,
                 submitted_at=rr.submitted_at,
                 deadline_s=rr.deadline_s,
+                prefill_only=replica.role == "prefill",
             )
             rr.replica = replica.index
             replica.touch()  # placement resets the idle heartbeat clock
@@ -265,10 +344,30 @@ class ServingRouter:
                 continue
             replica.observe_step()
             for result in step_results:
+                rr = self._inflight.get(result.request_id)
+                if result.finish_reason == "prefilled" and rr is not None:
+                    # NOT terminal to the fleet: the prefill pool parked this
+                    # request's live KV. Queue the handoff — next step's
+                    # re-offer relays the pages to a decode replica (or falls
+                    # back to re-prefill there). The caller never sees a
+                    # "prefilled" result, so offered==terminated accounting
+                    # holds unchanged under disaggregation.
+                    rr.phase = "decode"
+                    rr.kv_source = replica.index
+                    rr.last_replica, rr.replica = rr.replica, None
+                    self._pending.append(rr)
+                    continue
                 self._inflight.pop(result.request_id, None)
                 results.append(result)
         for replica in self.replicas:
-            if replica.state is ReplicaState.DRAINING and not replica.engine.busy:
+            if (
+                replica.state is ReplicaState.DRAINING
+                and not replica.engine.busy
+                and not getattr(replica.engine, "parked_count", 0)
+            ):
+                # parked KV pins the drain open: the replica's pages must
+                # stay readable until every pending handoff acks or falls
+                # back — only then is the drain complete
                 replica.mark_dead("drained")
                 self._fleet_record({"event": "drained", "replica": replica.index})
         self._steps += 1
@@ -315,10 +414,19 @@ class ServingRouter:
 
     # -- placement -----------------------------------------------------------
 
-    def _placement_order(self) -> list[EngineReplica]:
-        """Placeable replicas, healthiest-then-least-loaded first."""
+    def _placement_order(self, phase: Optional[str] = None) -> list[EngineReplica]:
+        """Placeable replicas serving ``phase`` (``"prefill"`` /
+        ``"decode"`` / None = any), healthiest-then-least-loaded first.
+        Mixed replicas serve both phases, so an all-mixed fleet behaves
+        exactly as before roles existed."""
+        if phase == "prefill":
+            serves = lambda r: r.serves_prefill  # noqa: E731
+        elif phase == "decode":
+            serves = lambda r: r.serves_decode  # noqa: E731
+        else:
+            serves = lambda r: True  # noqa: E731
         return sorted(
-            (r for r in self.replicas if r.placeable),
+            (r for r in self.replicas if r.placeable and serves(r)),
             key=lambda r: (r.state is not ReplicaState.HEALTHY, r.load_score(), r.index),
         )
 
@@ -336,34 +444,65 @@ class ServingRouter:
         now = time.perf_counter()
         for rr in self._pending:
             if rr.cancelled:
+                self._drop_parked(rr)  # the parked pages must not strand
                 self._inflight.pop(rr.id, None)
                 results.append(self._terminal(rr, "cancelled", now))
                 continue
             if rr.deadline_at is not None and now >= rr.deadline_at:
+                self._drop_parked(rr)
                 self._inflight.pop(rr.id, None)
                 results.append(self._terminal(rr, "expired", now))
                 continue
             settled = False  # placed on a replica, or terminally failed
+            # the live-KV source: a prefill-pool replica holding this
+            # request's parked pages. A dead source's memory is gone
+            # (SIGKILL semantics — _on_replica_death already recorded the
+            # fallback); re-prefill is then the path.
             src = (
-                self.replicas[rr.last_replica]
-                if rr.last_replica is not None
+                self.replicas[rr.kv_source]
+                if rr.kv_source is not None
                 else None
             )
-            for replica in self._placement_order():
+            if src is not None and not src.alive:
+                src, rr.kv_source = None, None
+            if (
+                src is not None
+                and rr.handoff_retry_at is not None
+                and now < rr.handoff_retry_at
+            ):
+                # inside the jittered retry backoff: the parked KV waits it
+                # out while the fleet decodes — neither retrying early nor
+                # falling through to a premature re-prefill
+                still_pending.append(rr)
+                continue
+            for replica in self._placement_order(rr.phase):
+                # the handoff: relay the parked fixed-shape pages to this
+                # decode-capable replica; on success the DESTINATION now
+                # schedules the request (adopt_kv seated it), so placement
+                # is done. A False either means the transfer fell back
+                # (parked pages released, kv_source cleared — the submit
+                # below re-prefills HERE, on the decode pool) or nothing
+                # was parked (plain failover re-home).
+                if src is not None and self._kv_handoff(src, replica, rr):
+                    settled = True
+                    break
+                if src is not None and rr.kv_source is not None:
+                    if rr.handoff_retry_at is not None and now < rr.handoff_retry_at:
+                        # the attempt FAILED and scheduled its jittered
+                        # backoff: stop probing destinations this step — an
+                        # immediate try against the next replica would burn
+                        # the whole retry budget in one step with zero
+                        # backoff, exactly when the transfer path is sick
+                        break
+                    # deferred: the parked KV is intact and this destination
+                    # is saturated — try the next one, and NEVER queue a
+                    # re-prefill while the pages wait (that would race two
+                    # copies of the request through two scheduling paths)
+                    continue
+                if src is not None:
+                    src = None  # fell back: re-prefill takes over below
                 if not replica.engine.queue_available:
                     continue
-                # the KV-handoff seam: when the previous host is still
-                # readable (graceful drain, not SIGKILL) a future relayout
-                # path moves the live cache slice instead of re-prefilling.
-                # A True would mean the KV moved — and this call site must
-                # then change how it schedules the request, so fail loudly
-                # rather than hand off AND re-prefill (delivering twice).
-                if src is not None and src.alive and self._kv_handoff(src, replica, rr):
-                    raise NotImplementedError(
-                        "_kv_handoff returned True but the re-home path only "
-                        "implements re-prefill — a live-KV relayout must also "
-                        "take over scheduling the request on the destination"
-                    )
                 try:
                     replica.engine.submit(
                         rr.prompt,
@@ -371,6 +510,10 @@ class ServingRouter:
                         request_id=rr.id,
                         submitted_at=rr.submitted_at,
                         deadline_s=rr.deadline_s,
+                        # a re-homed not-yet-prefilled request re-enters the
+                        # prefill pool's park-and-handoff path; a post-park
+                        # fallback re-prefills to COMPLETION wherever it lands
+                        prefill_only=rr.phase == "prefill" and replica.role == "prefill",
                     )
                 except Exception as error:  # noqa: BLE001 - classifier decides
                     if is_fleet_transient(error):
@@ -385,13 +528,29 @@ class ServingRouter:
                 self.rehomed += 1
                 self._fleet_record(
                     {"event": "rehome", "request_id": rr.id, "replica": replica.index,
-                     "failovers": rr.failovers}
+                     "phase": rr.phase, "failovers": rr.failovers}
                 )
                 settled = True
                 break
+            if (
+                not settled
+                and rr.kv_source is not None
+                and not self._placement_order(rr.phase)
+            ):
+                # no placeable destination exists at all (e.g. the decode
+                # pool died while the source was DRAINING — promotion only
+                # covers placeable survivors): finish the request on its own
+                # source, like any active slot a drain lets run to
+                # completion. Without this, the drain waits on the handoff
+                # and the handoff waits on a destination that can never
+                # exist — a livelock that would spin run() forever.
+                parked_src = self.replicas[rr.kv_source]
+                if parked_src.alive and self._kv_handoff(parked_src, parked_src, rr):
+                    settled = True
             if not settled:
                 if not any(r.alive for r in self.replicas):
                     # nobody left to ever take it: terminate, don't strand
+                    self._drop_parked(rr)
                     self._inflight.pop(rr.id, None)
                     results.append(self._terminal(rr, "failed", now))
                 else:
@@ -433,6 +592,18 @@ class ServingRouter:
             {"event": "replica_death", "replica": replica.index, "reason": reason,
              "orphaned": len(orphans)}
         )
+        # parked KV died with the process: every pending handoff sourced
+        # here can never complete — record the fallback now (the re-offer
+        # loop re-prefills those requests on the decode pool)
+        for rr in self._inflight.values():
+            if rr.kv_source == replica.index:
+                rr.kv_source = None
+                replica.engine.stats.record_handoff_fallback()
+                self._fleet_record(
+                    {"event": "kv_handoff", "outcome": "fell_back",
+                     "request_id": rr.id, "src": replica.index, "dst": None,
+                     "error": "source replica died with KV parked"}
+                )
         now = time.perf_counter()
         for rr in orphans:
             rr.last_replica, rr.replica = rr.replica, None
@@ -450,35 +621,219 @@ class ServingRouter:
             else:
                 self.failovers += 1
                 self._pending.append(rr)
+        self._rebalance_roles()
+
+    def _rebalance_roles(self) -> None:
+        """Pool-loss degradation: when the LAST prefill-capable replica dies
+        or drains, the decode pool's survivors are promoted to ``mixed`` (and
+        symmetrically for a lost decode pool) — the fleet keeps serving,
+        slower, instead of shedding every new request against a pool that no
+        longer exists. Promotion is one-way: a revived replica rejoins with
+        its configured role, but survivors stay mixed until an operator
+        re-partitions — flapping roles on every health transition would
+        thrash placement for no capacity gain."""
+        if not self.disaggregated:
+            return
+        for lost, survivor_role, serves in (
+            ("prefill", "decode", lambda r: r.serves_prefill),
+            ("decode", "prefill", lambda r: r.serves_decode),
+        ):
+            if any(r.placeable and serves(r) for r in self.replicas):
+                continue
+            promoted = [
+                r for r in self.replicas if r.placeable and r.role == survivor_role
+            ]
+            for r in promoted:
+                r.role = "mixed"
+            if promoted:
+                self._fleet_record(
+                    {"event": "pool_degraded", "pool": lost,
+                     "promoted": [r.index for r in promoted],
+                     "detail": f"no placeable {lost}-capable replica — the "
+                               f"{survivor_role} pool now serves mixed"}
+                )
 
     def _kv_handoff(self, src: EngineReplica, dst: EngineReplica, rr: RoutedRequest) -> bool:
-        """Seam for live-KV migration between replicas. A request's cache
-        slice is an array-redistribution problem (arXiv:2112.01075 — relayout
-        through portable collectives without materializing the full buffer);
-        the paged engine now gives the problem its concrete source
-        description — :meth:`~.engine.ServingEngine.kv_page_layout` names
-        exactly which physical pages hold the request's live KV, in what
-        order, with how many valid positions — so the transfer is a gather of
-        ``len(pages)`` fixed-shape blocks, not a relayout of a ``max_len``
-        slab. The relayout itself has not landed: this returns False and
-        failover re-prefills from the prompt, which is correct by
-        construction. The signature is the contract: src may already be
-        unreachable for anything but its device buffers, and a False here
-        must always leave re-prefill as the path."""
+        """Live-KV migration between pools: relay ``rr``'s parked pages from
+        ``src`` into ``dst``'s pool and hand over scheduling. A request's
+        cache slice is an array-redistribution problem (arXiv:2112.01075 —
+        move fixed blocks, never materialize the full buffer):
+        :meth:`~.engine.ServingEngine.kv_page_layout` names exactly which
+        physical pages hold the live KV, in what order, with how many valid
+        positions, so the transfer is ``len(pages)`` fixed-shape block reads
+        (``extract_pages``) and writes (``adopt_kv``'s jitted per-page copy
+        program) — both keyed only on ``page_shape``, so steady-state
+        handoffs compile nothing in either pool.
+
+        The TRANSACTION: the source's pages stay refcounted (parked) until
+        ``adopt_kv`` returns having verified token-exact adoption — only
+        then does the ack (``release_parked``) drop them. An attempt that
+        stalls past ``handoff_timeout_s``, raises, or loses its source
+        mid-transfer is retried under the jittered ``handoff_retry`` policy
+        — ONE attempt per router step, the policy's jittered delay becoming
+        a not-before gate (``rr.handoff_retry_at``) on the next step's
+        re-offer rather than an in-step sleep: a sleep here would stall
+        decode on EVERY replica for the duration (step() is single-threaded
+        and this runs before the stepping loop), turning one flaky transfer
+        into a fleet-wide inter-token latency spike. When the budget is
+        spent (or the failure is fatal — incompatible pool geometry) the
+        parked pages are released and this returns False with
+        ``rr.kv_source`` cleared, which tells the caller to re-prefill on
+        the decode pool: never a token delivered twice (a parked request
+        has delivered none), never a request stranded (re-prefill needs
+        only the prompt, which the router holds). Returns True when ``dst``
+        adopted — the destination is now scheduling the request.
+
+        ``src is dst`` (pool degradation re-seated the source as mixed)
+        short-circuits to ``resume_parked``: the pages are already in the
+        right pool, so the table row re-attaches with zero copies."""
         layout = self.kv_handoff_layout(src, rr)
-        if layout is None:
-            return False  # nothing readable to relay: re-prefill is the path
-        # the source side of the 2112.01075 transfer is fully described;
-        # record it so the seam's readiness is observable, then fall back
+        if layout is None or not layout.get("parked"):
+            # a stale source pointer (nothing parked there anymore) must not
+            # leave the request waiting on a handoff that can never happen
+            self._drop_parked(rr)
+            return False  # nothing parked to relay: re-prefill is the path
+        from ..resilience.retry import is_handoff_transient
+
+        policy = self.handoff_retry
+        pages = layout["pages"]
+        # destination backpressure DEFERS, it does not fail: a saturated
+        # pool frees lanes/pages only when the router steps it — which an
+        # in-step retry loop cannot cause — so the parked KV simply waits
+        # (kv_source intact) and the next fleet step re-offers
+        if dst.index == src.index:
+            if src.engine.cache.lanes.free_count == 0:
+                return False
+        elif not dst.engine.can_adopt(len(pages)):
+            return False
+        attempt = rr.handoff_attempts
+        seq = self._handoff_attempt_seq
+        self._handoff_attempt_seq += 1
+        src.engine.stats.record_handoff_attempt()
+        t0 = time.perf_counter()
+        try:
+            if dst.index == src.index:
+                if not src.engine.resume_parked(
+                    rr.id, rr.prompt, rr.max_new_tokens,
+                    submitted_at=rr.submitted_at, deadline_s=rr.deadline_s,
+                ):
+                    raise QueueFull(
+                        "no free lane to resume the parked request",
+                        queue_depth=src.engine.scheduler.waiting,
+                        retry_after_s=src.engine.retry_after_hint(),
+                    )
+                moved_bytes = 0
+            else:
+                kb, vb = self._transfer_blocks(src, pages, seq)
+                if (
+                    self.handoff_timeout_s is not None
+                    and time.perf_counter() - t0 > self.handoff_timeout_s
+                ):
+                    raise HandoffLost(
+                        f"handoff of request {rr.id} exceeded "
+                        f"{self.handoff_timeout_s}s — transfer treated as lost"
+                    )
+                if not src.alive:
+                    raise HandoffLost("source replica died mid-transfer")
+                dst.engine.adopt_kv(
+                    rr.prompt, rr.max_new_tokens, layout, kb, vb,
+                    request_id=rr.id, submitted_at=rr.submitted_at,
+                    deadline_s=rr.deadline_s,
+                )
+                moved_bytes = int(kb.nbytes + vb.nbytes)
+        except QueueFull:
+            # the pre-check raced real admission (pages pinned by live
+            # holders that the prefix-eviction estimate counted as
+            # reclaimable): same verdict — defer, parked KV intact, and no
+            # retry budget spent (backpressure is not a transfer failure)
+            return False
+        except Exception as error:  # noqa: BLE001 - classifier decides
+            rr.handoff_attempts += 1
+            final = (
+                rr.handoff_attempts >= policy.max_attempts
+                or not is_handoff_transient(error)
+                or not src.alive  # the parked pages are gone with the process
+            )
+            if not final:
+                src.engine.stats.record_handoff_retry()
+                # the jittered backoff, as a GATE: the re-offer skips this
+                # request until the stamp passes, while every replica keeps
+                # decoding — in-step sleeping here would stall the fleet
+                rr.handoff_retry_at = time.perf_counter() + policy.delay_for(attempt)
+                self._fleet_record(
+                    {"event": "kv_handoff", "outcome": "retried",
+                     "request_id": rr.id, "src": src.index, "dst": dst.index,
+                     "attempt": rr.handoff_attempts,
+                     "error": f"{type(error).__name__}: {error}"}
+                )
+                return False
+            # the ladder's last rung: release the parked pages (their
+            # content regenerates bit-identically from the prompt) and
+            # degrade to re-prefill on the decode pool
+            self._drop_parked(rr)
+            src.engine.stats.record_handoff_fallback()
+            self._fleet_record(
+                {"event": "kv_handoff", "outcome": "fell_back",
+                 "request_id": rr.id, "src": src.index, "dst": dst.index,
+                 "attempts": rr.handoff_attempts,
+                 "error": f"{type(error).__name__}: {error}"}
+            )
+            return False
+        elapsed = time.perf_counter() - t0
+        # the ack: adoption verified token-exact — ONLY now do the
+        # source-side refcounts drop (resume_parked already consumed
+        # its own parked entry; release is then a no-op)
+        if src.alive:
+            src.engine.release_parked(rr.id)
+        rr.kv_source = None
+        rr.phase = "decode"
+        rr.replica = dst.index
+        if rr.cancelled:
+            # a cancel raced the transfer: honor it on the destination
+            # immediately so its True is never contradicted
+            dst.engine.cancel(rr.id)
+        dst.touch()
+        self.placements[dst.index] += 1
+        self.kv_handoffs += 1
+        src.engine.stats.record_handoff(len(pages), moved_bytes, elapsed)
         self._fleet_record(
-            {"event": "kv_handoff_available", "request_id": rr.id,
-             "src": src.index, "dst": dst.index, "pages": len(layout["pages"]),
-             "page_size": layout["page_size"], "length": layout["length"]}
+            {"event": "kv_handoff", "outcome": "adopted", "request_id": rr.id,
+             "src": src.index, "dst": dst.index, "pages": len(pages),
+             "bytes": moved_bytes, "seconds": round(elapsed, 6),
+             "attempts": rr.handoff_attempts + 1}
         )
-        return False
+        return True
+
+    def _transfer_blocks(self, src: EngineReplica, pages, attempt_seq: int):
+        """The wire: read the parked pages' fixed-shape blocks off the
+        source. Chaos rides HERE — mid-transfer, between deciding to move
+        and the destination adopting — so the stall/loss drills exercise
+        exactly the window where a real interconnect fails."""
+        if self.chaos is not None:
+            stall = self.chaos.handoff_stall(attempt_seq)
+            if stall:
+                time.sleep(stall)
+            if self.chaos.handoff_loss(attempt_seq):
+                raise HandoffLost("chaos: source blocks lost mid-transfer")
+        return src.engine.extract_pages(pages)
+
+    def _drop_parked(self, rr: RoutedRequest) -> None:
+        """Release a pending request's parked source pages (terminal from
+        the router, or handoff fallback): without this, a cancelled/expired
+        request would pin its pages at the source forever."""
+        if rr.kv_source is None:
+            return
+        src = self.replicas[rr.kv_source]
+        rr.kv_source = None
+        rr.handoff_retry_at = None
+        if src.alive:
+            try:
+                src.engine.release_parked(rr.id)
+            except Exception:  # noqa: BLE001 - a half-dead source changes nothing
+                pass
 
     def kv_handoff_layout(self, src: EngineReplica, rr: RoutedRequest) -> Optional[dict]:
-        """The page-granular source description a handoff would relay: the
+        """The page-granular source description a handoff relays: the
         engine's :meth:`~.engine.ServingEngine.kv_page_layout` for ``rr``,
         guarded by the fleet's reachability rules (a DEAD replica's memory is
         gone — SIGKILL semantics — so only a live source is readable)."""
@@ -499,8 +854,9 @@ class ServingRouter:
         replica.start_drain(reason)  # → _on_transition → _rehome_drained
         moved = self._drain_moved.pop(index, 0)
         # an already-idle replica completes its drain right here — step()'s
-        # completion sweep only runs when the fleet has work to step
-        if not replica.engine.busy:
+        # completion sweep only runs when the fleet has work to step (parked
+        # KV keeps the drain open: those pages must survive until handoff)
+        if not replica.engine.busy and not getattr(replica.engine, "parked_count", 0):
             replica.mark_dead("drained")
             self._fleet_record({"event": "drained", "replica": replica.index})
         return moved
@@ -557,6 +913,10 @@ class ServingRouter:
         )
         if state is ReplicaState.DRAINING:
             self._drain_moved[replica.index] = self._rehome_drained(replica, reason)
+            # a draining pool member stops placing: if it was the pool's
+            # last, the opposite pool must go mixed NOW — its drain may take
+            # many steps, and new requests cannot wait for it to finish
+            self._rebalance_roles()
 
     def _terminal(self, rr: RoutedRequest, reason: str, now: float) -> ServingResult:
         return ServingResult(
@@ -574,8 +934,13 @@ class ServingRouter:
 
     def metrics(self) -> dict:
         """Fleet-aggregated serving metrics plus router-level counters and
-        the per-replica health summaries."""
-        out = fleet_rollup([r.engine.stats for r in self.replicas])
+        the per-replica health summaries. Disaggregated fleets add the
+        handoff economy (attempted/adopted/fallbacks, pages and bytes
+        moved, handoff p50/p99) and per-pool occupancy from the rollup."""
+        out = fleet_rollup(
+            [r.engine.stats for r in self.replicas],
+            roles=[r.role for r in self.replicas] if self.disaggregated else None,
+        )
         # every engine's CompileTracker observes the PROCESS-wide compile
         # stream (jax.monitoring has no per-engine scoping), so replica
         # counts are views of one stream — max, not sum, is the fleet count
@@ -586,8 +951,10 @@ class ServingRouter:
         out["failed_failovers"] = self.failed_failovers
         out["rehomed"] = self.rehomed
         out["replica_deaths"] = self.replica_deaths
+        out["kv_handoffs"] = self.kv_handoffs
         out["pending_depth"] = len(self._pending)
         out["placements"] = list(self.placements)
+        out["replica_roles"] = [r.role for r in self.replicas]
         out["replica_health"] = [r.summary() for r in self.replicas]
         return out
 

@@ -80,6 +80,10 @@ class Request:
     finish_reason: Optional[str] = None  # "eos" | "length" | "expired" | "cancelled"
     generated: list[int] = field(default_factory=list)
     cancelled: bool = False
+    # disaggregated serving (router.py): a prefill-pool engine runs this
+    # request's prefill and PARKS the finished KV for handoff instead of
+    # decoding — the request leaves the engine as "prefilled", not "length"
+    prefill_only: bool = False
     requeues: int = 0  # times a bad slot sent this request back to the queue
     preemptions: int = 0  # times page pressure evicted this request (paged KV)
     # paged-prefill progress: tokens of prompt[:-1] already in cache pages
@@ -230,6 +234,19 @@ class ContinuousBatchingScheduler:
             request.admitted_at = time.perf_counter()
             self.slots[slot] = request
             yield slot, request
+
+    def adopt(self, request: Request, slot: int) -> Request:
+        """Seat an externally prefilled request directly into ``slot`` —
+        the destination half of a live-KV handoff (engine ``adopt_kv``). The
+        request never waits in this scheduler's queue: its prefill already
+        ran on another engine, and the caller has already claimed the lane
+        and pages its cache view needs."""
+        if self.slots[slot] is not None:
+            raise ValueError(f"slot {slot} already holds request {self.slots[slot].id}")
+        request.slot = slot
+        request.admitted_at = time.perf_counter()
+        self.slots[slot] = request
+        return request
 
     def drain_queue(self) -> list[Request]:
         """Remove and return every waiting request (drain: the caller re-homes

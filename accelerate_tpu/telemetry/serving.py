@@ -77,6 +77,20 @@ class ServingStats:
         self.page_occupancy_sum = 0.0
         self.peak_pages_in_use = 0
         self.last_pages_in_use = 0
+        # disaggregated-serving handoff economy (router.py): parked/adopted
+        # count on the engine that did the work; the transfer ledger
+        # (attempts, retries, fallbacks, pages/bytes moved, latency samples)
+        # is recorded by the router on the SOURCE replica's stats — its pages
+        # moved — and sums across the fleet like every other counter
+        self.requests_parked = 0  # prefill-only completions awaiting handoff
+        self.requests_adopted = 0  # requests seated here via live-KV handoff
+        self.handoffs_attempted = 0
+        self.handoffs_retried = 0
+        self.handoffs_adopted = 0
+        self.handoff_fallbacks = 0
+        self.handoff_pages_moved = 0
+        self.handoff_bytes_moved = 0
+        self.handoff_seconds: list[float] = []  # per adopted handoff, end to end
 
     # -- intake ------------------------------------------------------------
 
@@ -125,6 +139,30 @@ class ServingStats:
 
     def record_preempted(self) -> None:
         self.requests_preempted += 1
+
+    def record_parked(self) -> None:
+        self.requests_parked += 1
+
+    def record_adopted(self) -> None:
+        self.requests_adopted += 1
+
+    def record_handoff_attempt(self) -> None:
+        self.handoffs_attempted += 1
+
+    def record_handoff_retry(self) -> None:
+        self.handoffs_retried += 1
+
+    def record_handoff_fallback(self) -> None:
+        self.handoff_fallbacks += 1
+
+    def record_handoff(self, pages: int, bytes_moved: int, seconds: float) -> None:
+        """One ADOPTED handoff's economy: fixed-shape blocks moved and the
+        end-to-end transfer+adopt latency (a raw sample, so the fleet rollup
+        can merge real percentiles)."""
+        self.handoffs_adopted += 1
+        self.handoff_pages_moved += pages
+        self.handoff_bytes_moved += bytes_moved
+        self.handoff_seconds.append(seconds)
 
     def record_cow_copy(self) -> None:
         self.cow_page_copies += 1
@@ -200,6 +238,14 @@ class ServingStats:
             "slot_quarantines": self.slot_quarantines,
             "slot_quarantine_releases": self.slot_quarantine_releases,
             "watchdog_trips": self.watchdog_trips,
+            "requests_parked": self.requests_parked,
+            "requests_adopted": self.requests_adopted,
+            "handoffs_attempted": self.handoffs_attempted,
+            "handoffs_retried": self.handoffs_retried,
+            "handoffs_adopted": self.handoffs_adopted,
+            "handoff_fallbacks": self.handoff_fallbacks,
+            "handoff_pages_moved": self.handoff_pages_moved,
+            "handoff_bytes_moved": self.handoff_bytes_moved,
             "throughput_tokens_per_sec": round(self.throughput_tokens_per_sec, 3),
             "slot_occupancy": round(self.mean_occupancy, 4),
             "max_active_slots": self.max_active,
@@ -228,10 +274,13 @@ class ServingStats:
         out.update(_percentiles_ms(self.step_seconds, "per_token"))
         out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
         out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
+        out.update(_percentiles_ms(self.handoff_seconds, "handoff", qs=(50, 99)))
         return out
 
 
-def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
+def fleet_rollup(
+    stats_list: list["ServingStats"], roles: Optional[list[str]] = None
+) -> dict:
     """Aggregate N replicas' :class:`ServingStats` into one fleet view.
 
     Counters sum; percentiles merge over the *raw* per-replica samples — a
@@ -241,7 +290,14 @@ def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
     concurrently, so windows overlap rather than add); occupancy and queue
     depth weight by each replica's step count. The dict mirrors
     :meth:`ServingStats.snapshot`'s keys (plus ``replicas``) so fleet and
-    single-engine metrics diff column-for-column."""
+    single-engine metrics diff column-for-column.
+
+    ``roles`` (one of ``prefill``/``decode``/``mixed`` per replica, aligned
+    with ``stats_list`` — a disaggregated router passes its pool map) adds
+    per-pool occupancy: ``pool_<role>_slot_occupancy`` /
+    ``pool_<role>_page_occupancy`` weight by the pool's own step counts, so
+    "the prefill pool idles while decode saturates" is readable straight off
+    the rollup instead of buried in per-replica snapshots."""
     out: dict = {"replicas": len(stats_list)}
     if not stats_list:
         return out
@@ -252,7 +308,10 @@ def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
         "requests_rehomed", "slot_quarantines", "slot_quarantine_releases",
         "watchdog_trips", "prefix_hits", "prefix_misses",
         "prefix_tokens_reused", "prefill_chunks", "requests_preempted",
-        "cow_page_copies", "page_pressure_events",
+        "cow_page_copies", "page_pressure_events", "requests_parked",
+        "requests_adopted", "handoffs_attempted", "handoffs_retried",
+        "handoffs_adopted", "handoff_fallbacks", "handoff_pages_moved",
+        "handoff_bytes_moved",
     )
     for key in counters:
         out[key] = sum(getattr(s, key) for s in stats_list)
@@ -286,4 +345,24 @@ def fleet_rollup(stats_list: list["ServingStats"]) -> dict:
         ([t for s in stats_list for t in s.latency_seconds], "request_latency"),
     ):
         out.update(_percentiles_ms(samples, prefix))
+    out.update(
+        _percentiles_ms(
+            [t for s in stats_list for t in s.handoff_seconds], "handoff", qs=(50, 99)
+        )
+    )
+    if roles:
+        for role in sorted(set(roles)):
+            group = [s for s, r in zip(stats_list, roles) if r == role]
+            out[f"pool_{role}_replicas"] = len(group)
+            group_steps = sum(s.steps for s in group)
+            if group_steps:
+                out[f"pool_{role}_slot_occupancy"] = round(
+                    sum(s.occupancy_sum for s in group) / group_steps, 4
+                )
+            paged_group = [s for s in group if s.num_pages and s.steps]
+            paged_steps = sum(s.steps for s in paged_group)
+            if paged_steps:
+                out[f"pool_{role}_page_occupancy"] = round(
+                    sum(s.page_occupancy_sum for s in paged_group) / paged_steps, 4
+                )
     return out

@@ -1047,6 +1047,100 @@ def bench_serving() -> dict:
             ),
         }
     )
+
+    # -- disaggregated pools: prefill/decode split + live-KV handoff ---------
+    # The ROADMAP's remaining half of disaggregated serving: the same mixed
+    # long/short trace through (a) a replicated router (every replica runs
+    # prefill AND decode — the PR 6 baseline) and (b) a disaggregated router
+    # (prompts prefill on the prefill pool, live KV hands off page-by-page to
+    # the decode pool). The headline number is the TTFT p99 comparison — a
+    # 4k-token prefill on a prefill replica no longer steals decode steps —
+    # plus the handoff economy (pages/bytes moved, handoff latency) and the
+    # prefill-kill chaos drill's fallback accounting. Per-pool steady state
+    # must still compile nothing: the extract/adopt-copy programs are part
+    # of warmup.
+    n_prefill = int(os.environ.get("BENCH_DISAGG_PREFILL", "1"))
+    n_decode = int(os.environ.get("BENCH_DISAGG_DECODE", "1"))
+    roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+    disagg_prompts = make_mixed_prompts(
+        n_requests, model.config.vocab_size, mixed_min, mixed_max,
+        long_fraction=0.1, long_multiplier=8, seed=3,
+    )
+    disagg_len = max(max_len, max(p.size for p in disagg_prompts) + max_new)
+
+    def disagg_engine():
+        return ServingEngine(model, params, num_slots=num_slots, max_len=disagg_len)
+
+    warm_router = ServingRouter(
+        engine_factory=disagg_engine, num_replicas=len(roles), roles=roles
+    )
+    warm_router.warmup()
+    _stage("disagg warmup done")
+    replicated = run_offered_load(
+        ServingRouter(engine_factory=disagg_engine, num_replicas=len(roles)),
+        disagg_prompts, max_new, float("inf"),
+    )
+    _stage("disagg replicated baseline done")
+    disagg_router = ServingRouter(
+        engine_factory=disagg_engine, num_replicas=len(roles), roles=roles
+    )
+    disagg = run_offered_load(disagg_router, disagg_prompts, max_new, float("inf"))
+    _stage("disagg point done")
+    disagg_plan = FaultPlan(replica_kill_step=kill_step, replica_kill_index=0)
+    disagg_drilled = ServingRouter(
+        engine_factory=disagg_engine, num_replicas=len(roles), roles=roles,
+        fault_plan=disagg_plan,
+    )
+    disagg_drill = run_offered_load(disagg_drilled, disagg_prompts, max_new, float("inf"))
+    _stage("disagg prefill-kill drill done")
+    rep_ttft = replicated.get("ttft_p99_ms")
+    result.update(
+        {
+            "fleet_disagg_prefill_replicas": n_prefill,
+            "fleet_disagg_decode_replicas": n_decode,
+            "fleet_disagg_requests": n_requests,
+            "fleet_replicated_ttft_p99_ms": rep_ttft,
+            "fleet_disagg_ttft_p99_ms": disagg.get("ttft_p99_ms"),
+            "fleet_disagg_ttft_p99_improvement_pct": (
+                round(100.0 * (1.0 - disagg["ttft_p99_ms"] / rep_ttft), 2)
+                if rep_ttft and disagg.get("ttft_p99_ms") is not None
+                else None
+            ),
+            "fleet_disagg_throughput_tok_s": disagg["throughput_tokens_per_sec"],
+            "fleet_disagg_handoffs": disagg["handoffs_adopted"],
+            "fleet_disagg_handoff_fallbacks": disagg["handoff_fallbacks"],
+            "fleet_disagg_handoff_pages_moved": disagg["handoff_pages_moved"],
+            "fleet_disagg_handoff_bytes_moved": disagg["handoff_bytes_moved"],
+            "fleet_disagg_handoff_p50_ms": disagg.get("handoff_p50_ms"),
+            "fleet_disagg_handoff_p99_ms": disagg.get("handoff_p99_ms"),
+            # any replica's tracker sees the process-wide compile stream, so
+            # one count covers BOTH pools — and it must be 0
+            "fleet_disagg_steady_state_compile_count": disagg["compile_count"],
+            "fleet_disagg_drill_offered": disagg_drill["offered_requests"],
+            "fleet_disagg_drill_terminated": disagg_drill["requests_completed"],
+            "fleet_disagg_drill_fallbacks": disagg_drill["handoff_fallbacks"],
+            # rate over the PARKED population (every parked request either
+            # adopts or falls back; a kill-path fallback never logged a
+            # transfer attempt, so attempts would undercount the denominator)
+            "fleet_disagg_drill_fallback_rate": (
+                round(
+                    disagg_drill["handoff_fallbacks"]
+                    / max(disagg_drill["requests_parked"], 1),
+                    4,
+                )
+            ),
+            "fleet_disagg_drill_replica_deaths": disagg_drilled.replica_deaths,
+            "fleet_disagg_drill_goodput_retained": (
+                round(
+                    disagg_drill["throughput_tokens_per_sec"]
+                    / disagg["throughput_tokens_per_sec"],
+                    4,
+                )
+                if disagg["throughput_tokens_per_sec"]
+                else None
+            ),
+        }
+    )
     return result
 
 

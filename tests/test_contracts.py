@@ -40,7 +40,10 @@ CONTRACTS_DIR = os.path.join(REPO_ROOT, "tests", "contracts")
 
 # the programs the repo promises contracts for (ISSUE 8 acceptance): the
 # bert steps, the llama FSDP step, the paged decode, every prefill span of
-# the canonical self-check engine, and the bench-scale programs
+# the canonical self-check engine, and the bench-scale programs — plus the
+# disaggregated-serving adopt/copy program (ISSUE 9: the per-page insert a
+# live-KV handoff writes through must keep donation intact and no baked
+# page-table constants)
 REQUIRED_CONTRACTS = {
     "bert_tiny_step",
     "llama_tiny_fsdp_step",
@@ -48,6 +51,7 @@ REQUIRED_CONTRACTS = {
     "serving_prefill_16",
     "serving_prefill_32",
     "serving_prefill_64",
+    "serving_adopt_kv",
     "bert_base_step",
     "llama_125m_fsdp_step",
 }

@@ -154,11 +154,14 @@ def test_routed_fleet_zero_steady_state_recompiles(llama):
     warm = tracker.snapshot()
     # ONE replica's worth of programs: decode + one prefill per bucket (the
     # paged engine scatters prefill pages directly — no insert programs; a
-    # dense engine would add one insert per bucket). The second replica's
-    # warmup hit the shared cache for every one of them.
+    # dense engine would add one insert per bucket) + the handoff pair
+    # (page extract + adopt-insert, paged only — steady-state handoffs must
+    # compile nothing). The second replica's warmup hit the shared cache for
+    # every one of them.
     engine = router.replicas[0].engine
     per_bucket = 1 if engine.paged else 2
-    assert warm["jit_cache_misses"] == 1 + per_bucket * len(engine.buckets)
+    handoff_pair = 2 if engine.paged else 0
+    assert warm["jit_cache_misses"] == 1 + per_bucket * len(engine.buckets) + handoff_pair
     router.generate_many(_prompts([3, 9, 20, 31, 6, 14], seed=4), max_new_tokens=4)
     steady = tracker.snapshot()
     tracker.stop()

@@ -58,12 +58,16 @@ def _poison_slot_kv(engine, slot):
         engine.cache.k = engine.cache.k.at[:, slot].set(jnp.nan)
 
 
-def _warm_program_count(engine):
+def _warm_program_count(engine, warmup=False):
     """Programs a fully-warmed engine holds: one decode step, plus one
     prefill program per bucket — and on the dense layout a separate insert
-    program per bucket (paged prefill scatters into the pool directly)."""
+    program per bucket (paged prefill scatters into the pool directly).
+    ``warmup=True`` counts what ``warmup()`` compiles, which for a paged
+    engine adds the handoff pair (page extract + adopt-insert) that
+    disaggregated steady state must never compile mid-traffic."""
     per_bucket = 1 if engine.paged else 2
-    return 1 + per_bucket * len(engine.buckets)
+    handoff_pair = 2 if warmup and engine.paged else 0
+    return 1 + per_bucket * len(engine.buckets) + handoff_pair
 
 
 # -- slot allocator -----------------------------------------------------------
@@ -492,7 +496,7 @@ def test_engine_warmup_compiles_every_bucket(llama):
     tracker = CompileTracker().start()
     engine.warmup()
     warm = tracker.snapshot()
-    assert warm["jit_cache_misses"] == _warm_program_count(engine)
+    assert warm["jit_cache_misses"] == _warm_program_count(engine, warmup=True)
     engine.generate_many(_prompts([3, 9, 20, 31], seed=13), max_new_tokens=4)
     steady = tracker.snapshot()
     tracker.stop()
