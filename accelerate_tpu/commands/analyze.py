@@ -13,8 +13,10 @@ Three modes that compose:
 2. **Self-check** (``--self-check``): build the repo's own canonical
    programs — the bert-tiny fused step, a llama-tiny FSDP step (sharded
    intent, the comm/compute-overlap baseline), a llama-tiny serving engine
-   (paged decode + every prefill chunk-span program), and the routed
-   2-replica decode path — and run the full compiled-program audit
+   (paged decode + every prefill chunk-span program — built with request
+   tracing ATTACHED, so the gate doubles as proof that tracing adds zero
+   device-program drift), and the routed 2-replica decode path — and run
+   the full compiled-program audit
    (donation aliasing, fp64, constants, collective inventory, replication,
    HBM memory, collective-overlap schedule) over each::
 
@@ -209,21 +211,30 @@ def _self_check(compile: bool):
     )
 
     # -- the serving engine: paged decode + EVERY prefill chunk-span program
-    # (prefill_chunk set, so the chunked-prefill span is contract-covered)
+    # (prefill_chunk set, so the chunked-prefill span is contract-covered).
+    # The engine is built TRACED on purpose: request-scoped tracing
+    # (telemetry/tracing.py) is host-side stamps only, so the traced decode/
+    # prefill programs must be byte-identical in contract terms to the
+    # untraced ones the serving_* contracts were recorded from — any device-
+    # program drift tracing ever introduced fails the gate right here
     _reset_state()
+    from ..telemetry.tracing import RequestTracer
+
     lparams = llama.init(jax.random.key(0))
     engine_kwargs = dict(num_slots=2, max_len=64, page_size=16, prefill_chunk=16)
-    engine = ServingEngine(llama, lparams, **engine_kwargs)
+    engine = ServingEngine(llama, lparams, tracer=RequestTracer(), **engine_kwargs)
     reports.append(engine.analyze(compile=compile, write_record=False))
 
     # the routed decode path: replication must not change the program, so a
     # 2-replica fleet's per-replica audits must come back exactly as clean
-    # (donation intact on EVERY replica) as the lone engine's above
+    # (donation intact on EVERY replica) as the lone engine's above — the
+    # fleet is traced too (one tracer shared across replicas, as in prod)
     from ..serving import ServingRouter
 
     router = ServingRouter(
         engine_factory=lambda: ServingEngine(llama, lparams, **engine_kwargs),
         num_replicas=2,
+        tracer=RequestTracer(),
     )
     reports.append(router.analyze(compile=compile, write_record=False))
     return reports

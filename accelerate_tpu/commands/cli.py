@@ -16,9 +16,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     subparsers = parser.add_subparsers(dest="command")
 
-    from . import analyze, cloud, config, env, estimate, launch, pod, profile, serve_bench, test, tpu, verify
+    from . import analyze, cloud, config, env, estimate, launch, pod, profile, serve_bench, test, tpu, trace, verify
 
-    for module in (analyze, cloud, config, env, estimate, launch, pod, profile, serve_bench, test, tpu, verify):
+    for module in (analyze, cloud, config, env, estimate, launch, pod, profile, serve_bench, test, tpu, trace, verify):
         module.register_subcommand(subparsers)
 
     args = parser.parse_args(argv)

@@ -112,6 +112,29 @@ def register_subcommand(subparsers):
         help="Serve from the dense per-slot slab instead of the paged pool "
         "(the comparison baseline)",
     )
+    parser.add_argument(
+        "--trace", action="store_true",
+        help="Request-scoped tracing: spans (queued/prefill/parked/handoff/"
+        "decode) for every request land in telemetry.jsonl and export to "
+        "Perfetto trace-event JSON; chaos drills additionally print the "
+        "slowest request's span breakdown",
+    )
+    parser.add_argument(
+        "--trace-dir", default=".",
+        help="Directory for telemetry.jsonl and the exported trace.json "
+        "(with --trace; default: current directory)",
+    )
+    parser.add_argument(
+        "--slo-ttft-s", type=float, default=60.0,
+        help="TTFT objective for the SLO burn-rate monitor (with --trace): "
+        "99%% of requests must see a first token within this many seconds",
+    )
+    parser.add_argument(
+        "--slo-window-s", type=float, default=3600.0,
+        help="SLO rolling-window width in seconds (with --trace). The "
+        "default covers a whole bench run, so the end-of-run burn-rate "
+        "line reflects every trace; narrow it to drill alert-style windows",
+    )
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--int8", action="store_true", help="int8 weight-only load path")
@@ -196,16 +219,42 @@ def run(args) -> int:
             f"longest prompt ({longest} tokens) + max_new_tokens"
         )
 
+    # request-scoped tracing: one tracer + hub shared by every sweep point's
+    # engines/fleet, so telemetry.jsonl accumulates the whole run's traces
+    # and the Perfetto export covers every point (drill included)
+    hub = tracer = slo = None
+    if args.trace:
+        from ..telemetry import (
+            RequestTracer,
+            SLOMonitor,
+            Telemetry,
+            TelemetryConfig,
+            default_objectives,
+        )
+
+        hub = Telemetry(config=TelemetryConfig(dir=args.trace_dir))
+        slo = SLOMonitor(
+            default_objectives(ttft_s=args.slo_ttft_s, window_s=args.slo_window_s),
+            telemetry=hub,
+        )
+        tracer = RequestTracer(telemetry=hub, slo=slo)
+
     def fresh_engine():
         # one model instance across engines: the jit cache lives on it, so
         # only the FIRST engine compiles — later sweep points (and every
         # extra replica) measure clean
-        return ServingEngine(
+        engine = ServingEngine(
             model, params, num_slots=args.num_slots, max_len=max_len,
             eos_token_id=args.eos_token_id, temperature=args.temperature,
             paged=not args.no_paged, page_size=args.page_size,
-            prefill_chunk=args.prefill_chunk,
+            prefill_chunk=args.prefill_chunk, tracer=tracer,
         )
+        # the hub attaches AFTER construction (exactly like the router wires
+        # replicas): a hub passed to the constructor would also hand the
+        # engine the hub's process-lifetime CompileTracker, and the sweep's
+        # per-point steady-state compile accounting needs each engine's own
+        engine.telemetry = hub
+        return engine
 
     def fresh_target(fault_plan=None):
         if n_replicas == 1 and not disagg:
@@ -219,7 +268,8 @@ def run(args) -> int:
             kwargs["handoff_timeout_s"] = fault_plan.stall_seconds / 2.0
         return ServingRouter(
             engine_factory=fresh_engine, num_replicas=n_replicas,
-            roles=roles, fault_plan=fault_plan, **kwargs,
+            roles=roles, fault_plan=fault_plan, tracer=tracer,
+            telemetry=hub, **kwargs,
         )
 
     def fleet_fault_plan():
@@ -251,6 +301,11 @@ def run(args) -> int:
     points.append(run_offered_load(fresh_target(), prompts, args.max_new_tokens, math.inf))
 
     drill = None
+    # traces_completed is MONOTONIC (the deque it feeds is bounded): the
+    # drill's traces are the last (completed_after - completed_before)
+    # entries whatever the ring evicted, where a raw len() index would
+    # shift under eviction and mis-slice
+    drill_trace_mark = tracer.traces_completed if tracer is not None else 0
     if args.chaos is not None:
         target = fresh_target(fault_plan=fleet_fault_plan())
         drill = run_offered_load(target, prompts, args.max_new_tokens, math.inf)
@@ -276,6 +331,34 @@ def run(args) -> int:
             }
         )
 
+    # -- trace export + SLO burn rates (with --trace) ------------------------
+    trace_path = None
+    slo_records = []
+    slowest_drill_trace = None
+    if tracer is not None:
+        import os as _os
+
+        from ..telemetry.tracing import to_perfetto
+
+        # evaluate AT the last retirement, not at export time: export/IO
+        # delay must not age the whole run's traces out of the window
+        records = list(tracer.completed)
+        last_stamp = max((r["t1"] for r in records), default=None)
+        slo_records = slo.evaluate(stamp=last_stamp)  # lands {"kind": "slo"} records
+        trace_path = _os.path.join(args.trace_dir, "trace.json")
+        with open(trace_path, "w") as f:
+            json.dump(to_perfetto(records), f)
+        if drill is not None:
+            # clamp to what the bounded ring still holds: a drill that
+            # completed more traces than the ring keeps must NOT reach back
+            # into surviving pre-drill sweep traces
+            n_drill = min(tracer.traces_completed - drill_trace_mark, len(records))
+            drill_traces = records[-n_drill:] if n_drill > 0 else []
+            if drill_traces:
+                slowest_drill_trace = max(
+                    drill_traces, key=lambda r: r.get("latency_s") or 0.0
+                )
+
     payload = {
         "model": args.model,
         "num_slots": args.num_slots,
@@ -299,8 +382,21 @@ def run(args) -> int:
         "steady_state_compile_count": points[-1]["compile_count"],
         "sweep": points,
     }
+    if tracer is not None:
+        payload["trace"] = {
+            "traces_completed": tracer.traces_completed,
+            "traces_open": tracer.open_count,  # must be 0 after drain
+            "perfetto_path": trace_path,
+            "slo": slo_records,
+        }
     if drill is not None:
         payload["chaos_drill"] = drill
+        if slowest_drill_trace is not None:
+            from ..telemetry.tracing import trace_summary
+
+            payload["chaos_drill"]["slowest_trace"] = trace_summary(
+                slowest_drill_trace
+            )
     if args.json:
         print(json.dumps(payload))
         return 0
@@ -381,5 +477,24 @@ def run(args) -> int:
             )
             + "goodput retained "
             + (f"{retained:.2f}x vs healthy" if retained is not None else "n/a")
+        )
+        if slowest_drill_trace is not None:
+            # WHERE the failed-over request spent its budget — top spans by
+            # duration, replica-tagged, so a drill reads as a story
+            print(f"slowest drill trace: {drill['slowest_trace']}")
+    if tracer is not None:
+        for record in slo_records:
+            burn = record["burn_rate"]
+            print(
+                f"slo {record['objective']}: burn rate "
+                + (f"{burn:.2f}" if burn is not None else "n/a (no data)")
+                + f" of budget {record['budget']:.3f}"
+                + (" — BREACHED" if record["breached"] else "")
+                + f" ({record['window_bad']}/{record['window_observed']} bad in window)"
+            )
+        print(
+            f"traces: {tracer.traces_completed} completed, "
+            f"{tracer.open_count} open (must be 0) — Perfetto JSON at "
+            f"{trace_path} (open in https://ui.perfetto.dev)"
         )
     return 0

@@ -211,6 +211,7 @@ class ServingEngine:
         max_probe_failures: int = 16,
         max_request_requeues: int = 2,
         name: Optional[str] = None,
+        tracer: Any = None,
         paged: bool = True,
         page_size: int = 16,
         num_pages: Optional[int] = None,
@@ -293,6 +294,13 @@ class ServingEngine:
         # instead of requeue-livelocking the engine
         self.max_request_requeues = max_request_requeues
         self._probe_failures: dict[int, int] = {}
+        # request-scoped tracing (telemetry/tracing.py): every span below is
+        # a host-side stamp the engine already sequences — tracing changes
+        # no compiled program (contract-gated by `analyze --self-check`) and
+        # adds no host sync. A routed fleet shares ONE tracer across its
+        # replicas so a handed-off request keeps one trace.
+        self.tracer = tracer
+        self._prefill_open: set[int] = set()  # request ids with an open prefill span
         self._decode_warm = False  # first decode completed (compile behind us)
         self._donation_checked = False  # one consult after the first compile
         self._draining = False  # drain(): stop admitting, finish active slots
@@ -742,6 +750,25 @@ class ServingEngine:
                 retry_after_s=hint,
             ) from None
         request.prefill_only = prefill_only
+        if self.tracer is not None and not self._warming:
+            # begin() is idempotent per id: a failover re-submit (or the
+            # handoff fallback re-prefill) JOINS the request's existing
+            # trace, opening a fresh honest queued span on the new replica.
+            # Only a trace's FIRST queued span backdates to submitted_at
+            # (queue-full deferral belongs in queue wait, exactly like TTFT);
+            # a re-opened one starts NOW — the request's earlier life is
+            # already in its earlier spans, and backdating would double-count
+            # it precisely in the chaos runs tracing exists to explain.
+            rejoining = self.tracer.has(request.id)
+            self.tracer.begin(
+                request.id, stamp=request.submitted_at,
+                prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
+            )
+            self.tracer.span_start(
+                request.id, "queued",
+                stamp=None if rejoining else request.submitted_at,
+                replica=self.name,
+            )
         self.stats.record_submit()
         return request.id
 
@@ -916,6 +943,14 @@ class ServingEngine:
             request.prefill_bucket = bucket
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :prefill_len] = request.prompt[:-1]
+            if self.tracer is not None:
+                # closed at this step's decode fence, the first host stamp
+                # sequenced after the dispatched prefill's device work
+                self.tracer.span_start(
+                    request.id, "prefill", replica=self.name,
+                    tokens=prefill_len, bucket=bucket,
+                )
+                self._prefill_open.add(request.id)
             slot_k, slot_v = self._prefill_program(bucket)(
                 self.params, ids, self._prefill_cache(bucket)
             )
@@ -926,6 +961,8 @@ class ServingEngine:
         # the prompt's last token is the first decode input: its logits ARE
         # the request's first token, so prefill logits are never consumed
         self._pending[slot] = request.prompt[-1]
+        if self.tracer is not None:
+            self.tracer.span_start(request.id, "decode", replica=self.name, slot=slot)
 
     # -- paged prefill / page-pressure machinery ----------------------------
 
@@ -975,6 +1012,14 @@ class ServingEngine:
             chunked_span = not self._warming and (
                 take < remaining or request.prefilled > request.prefix_hit
             )
+            if self.tracer is not None:
+                # one span per chunk (prefill[i]): opened at dispatch, closed
+                # at the first decode fence sequenced after it
+                self.tracer.span_start(
+                    request.id, "prefill", replica=self.name,
+                    tokens=take, span=span, position=request.prefilled,
+                )
+                self._prefill_open.add(request.id)
             # the table ROW is copied at dispatch: jax's CPU H2D is zero-copy,
             # so handing the program a live view of `tables` races host-side
             # mutation (park/retire zero the row right after this dispatch,
@@ -1012,6 +1057,13 @@ class ServingEngine:
                     self.cache.tables[slot, :blocks],
                 )
         if request.prefill_only:
+            if self.tracer is not None:
+                # park is the host event that ends this request's prefill
+                # phase HERE: close the chunk span now (the parked span must
+                # not start before its prefill ends) and open `parked`, which
+                # stays open until the handoff acks, falls back, or resumes
+                self._prefill_open.discard(request.id)
+                self.tracer.span_end(request.id, "prefill", stats=self.stats)
             pages = self.cache.park(slot)
             self._parked[request.id] = {
                 "pages": pages,
@@ -1022,6 +1074,10 @@ class ServingEngine:
                 "dtype": str(self.cache.dtype),
             }
             self._pending[slot] = 0
+            if self.tracer is not None:
+                self.tracer.span_start(
+                    request.id, "parked", replica=self.name, pages=len(pages)
+                )
             done = self.scheduler.retire(slot, "prefilled")
             self.stats.record_parked()
             self._resilience(
@@ -1031,6 +1087,8 @@ class ServingEngine:
         self.cache.lengths[slot] = prefill_len
         self.cache.active[slot] = True
         self._pending[slot] = request.prompt[-1]
+        if self.tracer is not None:
+            self.tracer.span_start(request.id, "decode", replica=self.name, slot=slot)
         return None
 
     def _preempt_slot(self, slot: int, reason: str) -> None:
@@ -1038,6 +1096,14 @@ class ServingEngine:
         preempted = self.scheduler.preempt_slot(slot)
         self.cache.retire(slot)
         self._pending[slot] = 0
+        if self.tracer is not None:
+            # the residence ended abruptly: close its spans and re-open
+            # `queued` — the request honestly waits again from the head
+            self._prefill_open.discard(preempted.id)
+            self.tracer.interrupt(preempted.id, outcome="preempted")
+            self.tracer.span_start(
+                preempted.id, "queued", replica=self.name, after="preempted"
+            )
         self.stats.record_preempted()
         self._resilience(
             {"event": "preempted", "request_id": preempted.id, "slot": slot,
@@ -1123,6 +1189,21 @@ class ServingEngine:
     # -- the engine loop ---------------------------------------------------
 
     def _result_for(self, request) -> ServingResult:
+        if self.tracer is not None and request.finish_reason is not None:
+            if request.finish_reason == "prefilled":
+                # NOT terminal: the router relays the parked KV and the trace
+                # continues on whichever replica decodes — one trace id
+                # across the pools is the whole point
+                self.tracer.event(
+                    request.id, "prefilled", stamp=request.finished_at,
+                    replica=self.name,
+                )
+            else:
+                self._prefill_open.discard(request.id)
+                self.tracer.retire(
+                    request.id, request.finish_reason, stamp=request.finished_at,
+                    stats=self.stats, replica=self.name,
+                )
         return ServingResult(
             request_id=request.id,
             prompt=request.prompt,
@@ -1207,6 +1288,14 @@ class ServingEngine:
         finished: list[ServingResult] = self._retire_degraded(t0)
         self._inject_chaos_burst()
         for slot, request in self.scheduler.admit_ready(self._free_slot):
+            if self.tracer is not None:
+                self.tracer.span_end(
+                    request.id, "queued", stamp=request.admitted_at, stats=self.stats
+                )
+                self.tracer.event(
+                    request.id, "admitted", stamp=request.admitted_at,
+                    replica=self.name, slot=slot, prefix_hit=request.prefix_hit,
+                )
             self._admit(slot, request)
         if self.paged:
             # one prefill span per still-prefilling slot (chunked prefill
@@ -1288,6 +1377,19 @@ class ServingEngine:
             # "working" are different claims until this check
             self._consult_donation()
         self._decode_warm = True
+        if self.tracer is not None:
+            # `now` is the decode fence the engine already paid for: close
+            # every prefill span dispatched up to here (their device work is
+            # sequenced before this fence) and drop SAMPLED step marks into
+            # open decode spans — the tracer never adds a sync of its own
+            for rid in self._prefill_open:
+                self.tracer.span_end(rid, "prefill", stamp=now, stats=self.stats)
+            self._prefill_open.clear()
+            if self._steps % self.tracer.sample_every == 0:
+                for slot in active_idx:
+                    marked = self.scheduler.slots[slot]
+                    if marked is not None and self.cache.active[slot]:
+                        self.tracer.mark_decode(marked.id, self._steps, now)
 
         delivered = 0
         for slot in active_idx:
@@ -1314,6 +1416,13 @@ class ServingEngine:
                     finished.append(self._result_for(done))
                 else:
                     self.scheduler.requeue_front(slot)
+                    if self.tracer is not None:
+                        self._prefill_open.discard(request.id)
+                        self.tracer.interrupt(request.id, outcome="quarantined")
+                        self.tracer.span_start(
+                            request.id, "queued", replica=self.name,
+                            after="quarantine",
+                        )
                     self.stats.record_requeue()
                     self._resilience(
                         {"event": "quarantine", "slot": slot, "request_id": request.id}
@@ -1354,6 +1463,10 @@ class ServingEngine:
             self.cache.lengths[slot] += 1
             if request.first_token_at is None:
                 request.first_token_at = now
+                if self.tracer is not None:
+                    self.tracer.event(
+                        request.id, "first_token", stamp=now, replica=self.name
+                    )
                 self.stats.record_first_token(request.ttft_s)
             hit_eos = self.eos_token_id is not None and token == self.eos_token_id
             if hit_eos or len(request.generated) >= request.max_new_tokens:
@@ -1608,6 +1721,14 @@ class ServingEngine:
         request.prefilled = length
         self.scheduler.adopt(request, slot)
         self._pending[slot] = prompt[-1]
+        if self.tracer is not None:
+            # a handed-off request joins its (source-opened) trace here: the
+            # decode span's replica names the pool that actually streams
+            self.tracer.begin(request.id, prompt_len=int(prompt.size),
+                              max_new_tokens=max_new_tokens)
+            self.tracer.span_start(
+                request.id, "decode", replica=self.name, slot=slot, adopted=True
+            )
         self.stats.record_adopted()
         return request.id
 
@@ -1633,6 +1754,10 @@ class ServingEngine:
         parked = self._parked.pop(request_id, None)
         if parked is None:
             return False
+        if self.tracer is not None:
+            self.tracer.span_end(
+                request_id, "parked", stats=self.stats, outcome="released"
+            )
         for page in parked["pages"]:
             self.cache.pages.decref(page)
         return True
@@ -1670,6 +1795,13 @@ class ServingEngine:
         request.prefilled = parked["length"]
         self.scheduler.adopt(request, slot)
         self._pending[slot] = prompt[-1]
+        if self.tracer is not None:
+            self.tracer.span_end(
+                request_id, "parked", stats=self.stats, outcome="resumed"
+            )
+            self.tracer.span_start(
+                request.id, "decode", replica=self.name, slot=slot, resumed=True
+            )
         self.stats.record_adopted()
         return True
 
@@ -1823,10 +1955,20 @@ class ServingEngine:
 
     def _resilience(self, payload: dict) -> None:
         """One ``{"kind": "resilience"}`` degradation record (shed, expiry,
-        cancellation, quarantine, watchdog) — no-op without a hub."""
+        cancellation, quarantine, watchdog) — no-op without a hub. Every
+        record carries a ``trace_id`` (null for non-request records, or when
+        tracing is off), so one ``telemetry.jsonl`` grep by trace id
+        reconstructs a request's full story across record kinds."""
         if self.telemetry is not None:
             if self.name is not None:
                 payload = {"engine": self.name, **payload}
+            if "trace_id" not in payload:
+                trace_id = (
+                    self.tracer.trace_id(payload.get("request_id"))
+                    if self.tracer is not None
+                    else None
+                )
+                payload = {**payload, "trace_id": trace_id}
             self.telemetry.write_record("resilience", payload)
 
     # -- alternate loaders -------------------------------------------------

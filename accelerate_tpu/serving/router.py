@@ -128,6 +128,7 @@ class ServingRouter:
         roles: Optional[Sequence[str]] = None,
         health: Optional[HealthPolicy] = None,
         telemetry: Any = None,
+        tracer: Any = None,
         fault_plan: Any = None,
         max_failovers: int = 2,
         handoff_timeout_s: Optional[float] = 5.0,
@@ -155,12 +156,19 @@ class ServingRouter:
             raise ValueError(
                 f"roles= names {len(roles)} replicas but the fleet has {len(engines)}"
             )
+        # ONE tracer across the fleet (telemetry/tracing.py): spans key by
+        # the fleet-unique request id, so a request prefilled on one pool
+        # and decoded on another keeps a single trace — the router adds the
+        # handoff_attempt spans, the engines everything else
+        self.tracer = tracer
         self.replicas = []
         for i, engine in enumerate(engines):
             if engine.name is None:
                 engine.name = f"replica{i}"
             if engine.telemetry is None and telemetry is not None:
                 engine.telemetry = telemetry
+            if engine.tracer is None and tracer is not None:
+                engine.tracer = tracer
             self.replicas.append(
                 EngineReplica(
                     i, engine, policy=health, on_transition=self._on_transition,
@@ -598,6 +606,13 @@ class ServingRouter:
         for rr in self._inflight.values():
             if rr.kv_source == replica.index:
                 rr.kv_source = None
+                if self.tracer is not None:
+                    # the parked span's pages died with the process — the
+                    # engine-side release that would close it can never run
+                    self.tracer.span_end(
+                        rr.id, "parked", stats=replica.engine.stats,
+                        outcome="fell_back",
+                    )
                 replica.engine.stats.record_handoff_fallback()
                 self._fleet_record(
                     {"event": "kv_handoff", "outcome": "fell_back",
@@ -606,6 +621,10 @@ class ServingRouter:
                 )
         now = time.perf_counter()
         for rr in orphans:
+            if self.tracer is not None:
+                # whatever spans were running on the dead replica ended with
+                # it; the survivor that re-homes the request opens fresh ones
+                self.tracer.interrupt(rr.id, stamp=now, outcome="replica_death")
             rr.last_replica, rr.replica = rr.replica, None
             if rr.cancelled:
                 # the client already gave up on it: terminate as cancelled
@@ -711,6 +730,13 @@ class ServingRouter:
         self._handoff_attempt_seq += 1
         src.engine.stats.record_handoff_attempt()
         t0 = time.perf_counter()
+        if self.tracer is not None:
+            # one handoff_attempt[j] span per attempt, in the SOURCE's lane
+            # (its pages move); the outcome lands when the attempt settles
+            self.tracer.span_start(
+                rr.id, "handoff_attempt", stamp=t0, replica=src.engine.name,
+                src=src.index, dst=dst.index, pages=len(pages),
+            )
         try:
             if dst.index == src.index:
                 if not src.engine.resume_parked(
@@ -746,6 +772,11 @@ class ServingRouter:
             # holders that the prefix-eviction estimate counted as
             # reclaimable): same verdict — defer, parked KV intact, and no
             # retry budget spent (backpressure is not a transfer failure)
+            if self.tracer is not None:
+                self.tracer.span_end(
+                    rr.id, "handoff_attempt", stats=src.engine.stats,
+                    outcome="deferred",
+                )
             return False
         except Exception as error:  # noqa: BLE001 - classifier decides
             rr.handoff_attempts += 1
@@ -756,6 +787,11 @@ class ServingRouter:
             )
             if not final:
                 src.engine.stats.record_handoff_retry()
+                if self.tracer is not None:
+                    self.tracer.span_end(
+                        rr.id, "handoff_attempt", stats=src.engine.stats,
+                        outcome="retried", error=type(error).__name__,
+                    )
                 # the jittered backoff, as a GATE: the re-offer skips this
                 # request until the stamp passes, while every replica keeps
                 # decoding — in-step sleeping here would stall the fleet
@@ -770,6 +806,11 @@ class ServingRouter:
             # the ladder's last rung: release the parked pages (their
             # content regenerates bit-identically from the prompt) and
             # degrade to re-prefill on the decode pool
+            if self.tracer is not None:
+                self.tracer.span_end(
+                    rr.id, "handoff_attempt", stats=src.engine.stats,
+                    outcome="fell_back", error=type(error).__name__,
+                )
             self._drop_parked(rr)
             src.engine.stats.record_handoff_fallback()
             self._fleet_record(
@@ -780,6 +821,11 @@ class ServingRouter:
             )
             return False
         elapsed = time.perf_counter() - t0
+        if self.tracer is not None:
+            self.tracer.span_end(
+                rr.id, "handoff_attempt", stats=src.engine.stats,
+                outcome="adopted", bytes=moved_bytes,
+            )
         # the ack: adoption verified token-exact — ONLY now do the
         # source-side refcounts drop (resume_parked already consumed
         # its own parked entry; release is then a no-op)
@@ -898,6 +944,8 @@ class ServingRouter:
             engine.name = f"replica{index}"
         if engine.telemetry is None and self.telemetry is not None:
             engine.telemetry = self.telemetry
+        if engine.tracer is None and self.tracer is not None:
+            engine.tracer = self.tracer
         replica.begin_recovery(engine)
         if warmup:
             engine.warmup()
@@ -919,6 +967,21 @@ class ServingRouter:
             self._rebalance_roles()
 
     def _terminal(self, rr: RoutedRequest, reason: str, now: float) -> ServingResult:
+        if self.tracer is not None:
+            # a router-made terminal (failed failover, cancelled/expired
+            # while pending): the trace must end exactly once HERE — no
+            # engine will ever retire this request. The stats sink is the
+            # LAST replica that hosted it (its books live on, dead or not,
+            # and the rollup sums them all): without one, exactly the failed
+            # requests would vanish from the fleet's trace/SLO counters and
+            # slo_bad_rate would report a clean fleet mid-drill
+            host = rr.last_replica if rr.last_replica is not None else 0
+            host_replica = self.replicas[host]
+            self.tracer.retire(
+                rr.id, reason, stamp=now,
+                stats=host_replica.engine.stats,
+                replica=host_replica.engine.name,
+            )
         return ServingResult(
             request_id=rr.id,
             prompt=rr.prompt,
@@ -930,6 +993,16 @@ class ServingRouter:
 
     def _fleet_record(self, payload: dict) -> None:
         if self.telemetry is not None:
+            if "trace_id" not in payload:
+                # every fleet record (kv_handoff, rehome, shed, ...) carries
+                # a trace_id — null for non-request records — so one grep of
+                # telemetry.jsonl reconstructs a request's full story
+                trace_id = (
+                    self.tracer.trace_id(payload.get("request_id"))
+                    if self.tracer is not None
+                    else None
+                )
+                payload = {**payload, "trace_id": trace_id}
             self.telemetry.write_record("fleet", {"fleet_step": self._steps, **payload})
 
     def metrics(self) -> dict:

@@ -13,7 +13,9 @@ from .hub import Telemetry, TelemetryConfig
 from .memory import MemoryMonitor
 from .profiler import ProfileWindow
 from .serving import ServingStats, fleet_rollup
+from .slo import SLObjective, SLOMonitor, default_objectives
 from .step_timer import StepTimer, drain_local_devices
+from .tracing import RequestTracer, to_perfetto, trace_summary
 
 __all__ = [
     "CompileTracker",
@@ -21,11 +23,17 @@ __all__ = [
     "MemoryMonitor",
     "PEAK_BF16_FLOPS",
     "ProfileWindow",
+    "RequestTracer",
     "ServingStats",
+    "SLObjective",
+    "SLOMonitor",
+    "default_objectives",
     "fleet_rollup",
     "StepTimer",
     "Telemetry",
     "TelemetryConfig",
     "device_peak_flops",
     "drain_local_devices",
+    "to_perfetto",
+    "trace_summary",
 ]

@@ -91,6 +91,16 @@ class ServingStats:
         self.handoff_pages_moved = 0
         self.handoff_bytes_moved = 0
         self.handoff_seconds: list[float] = []  # per adopted handoff, end to end
+        # request-trace + SLO accounting (telemetry/tracing.py, slo.py):
+        # counters sum across the fleet; span durations are RAW samples per
+        # span kind so the rollup can merge real percentiles — a mean of
+        # per-replica span p99s is not a fleet p99, same argument as the
+        # handoff latency merge above
+        self.traces_completed = 0
+        self.trace_spans = 0
+        self.span_seconds: dict[str, list[float]] = {}
+        self.slo_good_events = 0
+        self.slo_bad_events = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -163,6 +173,21 @@ class ServingStats:
         self.handoff_pages_moved += pages
         self.handoff_bytes_moved += bytes_moved
         self.handoff_seconds.append(seconds)
+
+    def record_span(self, kind: str, seconds: float) -> None:
+        """One closed trace span's duration, as a raw sample keyed by span
+        kind (queued / prefill / parked / handoff_attempt / decode)."""
+        self.span_seconds.setdefault(kind, []).append(seconds)
+        self.trace_spans += 1
+
+    def record_trace_completed(self) -> None:
+        self.traces_completed += 1
+
+    def record_slo_event(self, good: bool) -> None:
+        if good:
+            self.slo_good_events += 1
+        else:
+            self.slo_bad_events += 1
 
     def record_cow_copy(self) -> None:
         self.cow_page_copies += 1
@@ -271,10 +296,18 @@ class ServingStats:
             out["page_pressure_events"] = self.page_pressure_events
             if self.steps:
                 out["page_occupancy"] = round(self.page_occupancy_sum / self.steps, 4)
+        out["traces_completed"] = self.traces_completed
+        out["trace_spans"] = self.trace_spans
+        out["slo_good_events"] = self.slo_good_events
+        out["slo_bad_events"] = self.slo_bad_events
         out.update(_percentiles_ms(self.step_seconds, "per_token"))
         out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
         out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
         out.update(_percentiles_ms(self.handoff_seconds, "handoff", qs=(50, 99)))
+        for kind in sorted(self.span_seconds):
+            out.update(
+                _percentiles_ms(self.span_seconds[kind], f"span_{kind}", qs=(50, 99))
+            )
         return out
 
 
@@ -311,7 +344,8 @@ def fleet_rollup(
         "cow_page_copies", "page_pressure_events", "requests_parked",
         "requests_adopted", "handoffs_attempted", "handoffs_retried",
         "handoffs_adopted", "handoff_fallbacks", "handoff_pages_moved",
-        "handoff_bytes_moved",
+        "handoff_bytes_moved", "traces_completed", "trace_spans",
+        "slo_good_events", "slo_bad_events",
     )
     for key in counters:
         out[key] = sum(getattr(s, key) for s in stats_list)
@@ -350,6 +384,16 @@ def fleet_rollup(
             [t for s in stats_list for t in s.handoff_seconds], "handoff", qs=(50, 99)
         )
     )
+    # trace-span percentiles merge exactly like the handoff economy: sums
+    # above for the counters, raw-sample concatenation per span kind here —
+    # the fleet's span_decode_p99_ms is the percentile of every replica's
+    # decode samples together, never a mean of per-replica p99s
+    slo_events = out["slo_good_events"] + out["slo_bad_events"]
+    if slo_events:
+        out["slo_bad_rate"] = round(out["slo_bad_events"] / slo_events, 6)
+    for kind in sorted({k for s in stats_list for k in s.span_seconds}):
+        samples = [t for s in stats_list for t in s.span_seconds.get(kind, ())]
+        out.update(_percentiles_ms(samples, f"span_{kind}", qs=(50, 99)))
     if roles:
         for role in sorted(set(roles)):
             group = [s for s, r in zip(stats_list, roles) if r == role]

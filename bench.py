@@ -1264,6 +1264,106 @@ def bench_resilience() -> dict:
     return result
 
 
+def bench_observability() -> dict:
+    """Request-tracing subsystem cost (accelerate_tpu/telemetry/tracing.py):
+
+    - **tracing overhead** — paired saturation points with the tracer OFF vs
+      ON (same model, prompts, engine shape; best-of-N pairs, the
+      ``resilience_guard_overhead_pct`` methodology). Tracing is host-side
+      stamps on events the engine already sequences — no device work, no
+      extra host sync — so ``tracing_overhead_pct`` must sit within
+      measurement noise (< 2% at default scale is the acceptance gate).
+    - **export cost** — ``trace_export_wall_s``: Perfetto trace-event JSON
+      of the traced run's span trees (the `accelerate-tpu trace` path).
+    - **SLO burn rates** — the default objectives evaluated over the traced
+      run's completed traces, plus the steady-state compile count under
+      tracing (must be 0: tracing compiles nothing).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import build_model
+    from accelerate_tpu.serving import ServingEngine, make_prompts, run_offered_load
+    from accelerate_tpu.telemetry import RequestTracer, SLOMonitor, default_objectives
+    from accelerate_tpu.telemetry.tracing import to_perfetto
+
+    _reset_state()
+    name = os.environ.get("BENCH_OBS_MODEL", "llama-125m")
+    num_slots = int(os.environ.get("BENCH_OBS_SLOTS", "8"))
+    max_len = int(os.environ.get("BENCH_OBS_MAX_LEN", "512"))
+    max_new = int(os.environ.get("BENCH_OBS_MAX_NEW", "32"))
+    n_requests = int(os.environ.get("BENCH_OBS_REQUESTS", "16"))
+    pairs = int(os.environ.get("BENCH_OBS_PAIRS", "3"))
+
+    model = build_model(name)
+    params = model.init(jax.random.key(0))
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+    p_max = min(192, max_len - max_new)
+    prompts = make_prompts(n_requests, model.config.vocab_size, min(16, p_max), p_max, seed=0)
+
+    def point(tracer):
+        engine = ServingEngine(
+            model, params, num_slots=num_slots, max_len=max_len, tracer=tracer
+        )
+        return run_offered_load(engine, prompts, max_new, float("inf"))
+
+    warm = ServingEngine(model, params, num_slots=num_slots, max_len=max_len)
+    warm.warmup()
+
+    # paired windows, alternating OFF/ON so ambient drift hits both sides;
+    # best-of-pairs on each side (the same argument as _best_window_rate:
+    # the MIN of ambient interference, not the mean of it)
+    rates_off: list[float] = []
+    rates_on: list[float] = []
+    traced_tracer = None
+    traced_point = None
+    for _ in range(pairs):
+        rates_off.append(point(None)["throughput_tokens_per_sec"])
+        tracer = RequestTracer()
+        traced_point = point(tracer)
+        traced_tracer = tracer
+        rates_on.append(traced_point["throughput_tokens_per_sec"])
+    best_off, best_on = max(rates_off), max(rates_on)
+    overhead_pct = (best_off / best_on - 1.0) * 100.0 if best_on > 0 else None
+
+    records = list(traced_tracer.completed)
+    t0 = time.perf_counter()
+    exported = json.dumps(to_perfetto(records))
+    export_wall = time.perf_counter() - t0
+
+    # window covers the whole run: evaluating the default 60s alert window
+    # at the final stamp would silently age out every trace retired more
+    # than a minute before the end on a slow machine (same fix as
+    # serve-bench's --slo-window-s default)
+    slo = SLOMonitor(default_objectives(ttft_s=600.0, window_s=3600.0))
+    for record in records:
+        slo.observe(record, stamp=record["t1"])
+    burn = {r["objective"]: r["burn_rate"] for r in slo.evaluate(
+        stamp=max(r["t1"] for r in records) if records else None
+    )}
+
+    return {
+        "observability_model": name,
+        "observability_requests": n_requests,
+        "observability_rate_untraced_tok_s": round(best_off, 3),
+        "observability_rate_traced_tok_s": round(best_on, 3),
+        # the acceptance gate: host-side stamps only, so this must sit in
+        # measurement noise (< 2% at default bench scale)
+        "tracing_overhead_pct": round(overhead_pct, 2) if overhead_pct is not None else None,
+        "trace_export_wall_s": round(export_wall, 4),
+        "observability_traces_completed": traced_tracer.traces_completed,
+        "observability_traces_open": traced_tracer.open_count,  # must be 0
+        "observability_trace_spans": sum(len(r["spans"]) for r in records),
+        "observability_export_bytes": len(exported),
+        "observability_slo_burn_rates": burn,
+        # tracing compiles nothing: the traced point's engine was fresh but
+        # its model's jit cache was warm, so any compile here is tracing's
+        "observability_steady_state_compile_count": traced_point["compile_count"],
+    }
+
+
 def bench_analysis() -> dict:
     """Analyzer-on-the-benchmarks (docs/analysis.md): audit the bert + llama
     step programs and record analyzer wall time plus the collective
@@ -1464,6 +1564,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "analysis":
         print(json.dumps(bench_analysis()))
         return
+    if os.environ.get("BENCH_ONLY") == "observability":
+        print(json.dumps(bench_observability()))
+        return
 
     device0 = jax.devices()[0]
     on_tpu = device0.platform == "tpu"
@@ -1506,6 +1609,7 @@ def main() -> None:
         ("serving", bench_serving, ()),
         ("resilience", bench_resilience, ()),
         ("analysis", bench_analysis, ()),
+        ("observability", bench_observability, ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport
