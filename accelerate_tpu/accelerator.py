@@ -229,6 +229,23 @@ class Accelerator:
 
         self.state = AcceleratorState(mixed_precision=mixed_precision, parallelism=parallelism)
         self.fsdp_plugin = fsdp_plugin
+        # -- ZeRO update sharding (parallel/zero.py): resolve the mesh intent
+        # once. zero_stage=None auto-enables on eligible meshes (data-parallel
+        # axes present, model axes trivial); 0 forces the legacy replicated
+        # update; >=1 demands sharding and fails loudly on an ineligible mesh.
+        from .parallel.zero import zero_eligible
+
+        requested = getattr(self.state.parallelism, "zero_stage", None)
+        eligible = zero_eligible(self.mesh, fsdp_plugin)
+        if requested is not None and requested >= 1 and not eligible:
+            raise ValueError(
+                f"zero_stage={requested} requested but the update cannot be "
+                "sharded on this configuration (needs a nontrivial data/fsdp "
+                "axis, no tensor/sequence/pipeline/expert axes, and no "
+                "stage<3 or cpu_offload FSDP plugin). Drop zero_stage or fix "
+                "the mesh."
+            )
+        self._zero_update_sharding = eligible and requested != 0
         self.model_parallel_plugin = model_parallel_plugin
         self.compilation_config = compilation_config or CompilationConfig()
         if (
@@ -440,6 +457,15 @@ class Accelerator:
                 )
         rules = self._partition_rules(model)
         shardings = infer_shardings(params, self.mesh, rules)
+        if self._zero_update_sharding:
+            # ZeRO storage layout: each parameter additionally split over the
+            # data-parallel axes (1/N params + 1/N optimizer state per chip;
+            # shardings_like propagates this to the moments automatically).
+            # Every step opens with the all-gathers for its forward and closes
+            # with reduce-scatter + sharded update (parallel/zero.py).
+            from .parallel.sharding import zero_update_shardings
+
+            shardings = zero_update_shardings(params, shardings, self.mesh)
         if device_placement if device_placement is not None else self.device_placement:
             params = shard_tree(params, shardings)
         from .utils.constants import MESH_AXIS_PIPELINE, MESH_AXIS_SEQUENCE
@@ -584,6 +610,23 @@ class Accelerator:
 
                 rules = self._partition_rules(model.module).with_fsdp_applied()
                 opt_reference_shardings = infer_shardings(model.params, self.mesh, rules)
+        if self._zero_update_sharding:
+            # the sharded update runs tx on 1/N shards, which is exact only
+            # for transforms that do not couple leaves (adam/sgd families);
+            # a clip_by_global_norm inside the chain would reduce over the
+            # local shard and train silently differently — fail loudly with
+            # the two fixes spelled out instead
+            from .parallel.zero import tx_couples_across_leaves
+
+            if tx_couples_across_leaves(tx, model.params):
+                raise ValueError(
+                    "This optimizer transform couples gradient leaves (e.g. "
+                    "an optax.clip_by_global_norm inside the chain), which "
+                    "the ZeRO sharded update would compute over each chip's "
+                    "1/N shard. Use accelerator.clip_grad_norm_() (exact "
+                    "cross-shard norm inside the step) or opt out with "
+                    "ParallelismConfig(zero_stage=0)."
+                )
         optimizer = AcceleratedOptimizer(
             tx,
             model.box,
@@ -593,6 +636,20 @@ class Accelerator:
             cpu_offload=cpu_offload,
         )
         optimizer.telemetry = self.telemetry if self.telemetry.enabled else None
+        if self.telemetry.enabled:
+            # per-chip residency of the state just allocated: under the ZeRO
+            # sharded update this is 1/N of the replicated layout — recorded
+            # so the saving is a telemetry number, not a claim
+            from .telemetry.memory import state_bytes_per_chip
+
+            self.telemetry.write_record(
+                "memory",
+                {
+                    "event": "optimizer_state_allocated",
+                    "opt_state_bytes_per_chip": state_bytes_per_chip(optimizer.opt_state),
+                    "zero_update_sharding": self._zero_update_sharding,
+                },
+            )
         self._optimizers.append(optimizer)
         return optimizer
 
@@ -742,6 +799,14 @@ class Accelerator:
             @partial(jax.jit, static_argnums=())
             def run(params, batch, scale):
                 value, grads = grad_fn(params, batch, scale)
+                # NOTE(zero): gradients are deliberately NOT constrained to
+                # the ZeRO storage layout here. GSPMD already lays them out
+                # like the (folded) params they mirror, and forcing the
+                # constraint trips this XLA version's "involuntary full
+                # rematerialization" resharding path, which we have measured
+                # miscomputing (same bug class as the donated FSDP fused
+                # step the ZeRO program replaced). The fused path gets its
+                # layout from explicit collectives instead.
                 return value, grads
 
             if len(self._grad_fns) >= self._GRAD_FN_CACHE_LIMIT:
@@ -954,9 +1019,13 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def _sharding_intent(self) -> bool:
-        """Whether the user configured model-state sharding — if so, a large
-        parameter resolving to full replication is a regression (ERROR), not
-        the expected data-parallel layout (INFO)."""
+        """Whether this configuration declares state sharding — if so, a
+        large input resolving to full replication is a regression (ERROR),
+        not the expected data-parallel layout (INFO). ZeRO update sharding is
+        declared intent: parameters AND optimizer state must arrive sharded,
+        so the replication audit asserts it rather than inventorying it."""
+        if self._zero_update_sharding:
+            return True
         p = getattr(self.state, "parallelism", None)
         if p is None:
             return False
@@ -1124,6 +1193,9 @@ class Accelerator:
             opt_state = jax.lax.with_sharding_constraint(opt_state, optimizer._opt_state_device_shardings)
             return params, opt_state, loss, scale, growth_tracker, skipped
 
+        # NOTE: parallel/zero.py's guarded_step_impl mirrors this ladder for
+        # the sharded update — a semantic change to skip/escalate/backoff
+        # belongs in both places (the resilience suite pins each).
         def guarded_step_impl(params, opt_state, batch, scale, growth_tracker, gstate, corrupt):
             loss, grads = loss_and_grads(params, batch, scale)
             if chaos_nan:
@@ -1186,9 +1258,36 @@ class Accelerator:
             return params, opt_state, loss, scale, growth_tracker, skipped, gstate
 
         donate_argnums = (0, 1) if donate else ()
-        jitted = jax.jit(
-            guarded_step_impl if res_on else step_impl, donate_argnums=donate_argnums
-        )
+        if self._zero_update_sharding:
+            # ZeRO sharded update (parallel/zero.py): the program opens with
+            # the param all-gathers (hidden behind forward compute), closes
+            # with reduce-scatter → sharded adamw on 1/N state. Signature-
+            # identical to the replicated jit below, so lower()/step() and
+            # the analysis seam serve both implementations unchanged.
+            from .parallel.zero import build_zero_step
+
+            jitted = build_zero_step(
+                mesh=self.mesh,
+                loss_fn=loss_fn,
+                tx=tx,
+                params_shardings=model.params_shardings,
+                opt_state_shardings=optimizer._opt_state_device_shardings,
+                batch_sharding=self.state.data_sharding(),
+                compute_cast=lambda tree: cast_floating(tree, policy.compute_dtype),
+                num_micro=num_micro,
+                remat_policy=remat_policy,
+                scaler_cfg=scaler_cfg,
+                clip_grad_norm=clip_grad_norm,
+                clip_grad_value=clip_grad_value,
+                guard_policy=gpolicy if guard is not None else None,
+                chaos_nan_target=chaos.nan_target if chaos_nan else None,
+                resilience_on=res_on,
+                donate=donate,
+            )
+        else:
+            jitted = jax.jit(
+                guarded_step_impl if res_on else step_impl, donate_argnums=donate_argnums
+            )
 
         def lower(batch):
             """AOT-lower the fused program against the LIVE params/opt_state —

@@ -489,8 +489,12 @@ def replication_audit(
     non-locally: one missing annotation replicates a tensor on every device
     with no error anywhere (arXiv:2105.04663 §3.3) — the expensive failure
     mode the Python source cannot show. With ``sharded_intent`` (the caller
-    configured model sharding) these are ERRORs; under pure data parallelism
-    they are inventory (INFO) so the report diffs when a config regresses."""
+    configured model sharding, or the default ZeRO update sharding is
+    active) these are ERRORs — for a train step the inputs include the
+    optimizer state, so "the moments quietly went replicated again" is an
+    asserted failure, not an inventory line (tests/test_zero.py seeds that
+    regression). Without declared intent they are inventory (INFO) so the
+    report still diffs when a config regresses."""
     import jax
 
     leaves = flatten_args_info(lowered)

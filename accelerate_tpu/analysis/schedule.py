@@ -3,10 +3,9 @@
 The collective *inventory* (program.py) says what a program communicates;
 it cannot say what that communication costs in wall-clock, because the cost
 depends on the schedule: an all-gather whose consumer immediately follows it
-serializes the interconnect into the critical path, while the same op issued
-as an ``all-gather-start`` with independent compute before its
-``all-gather-done`` is (up to bandwidth) free. This pass reads the post-SPMD
-HLO and classifies every collective:
+serializes the interconnect into the critical path, while the same transfer
+with independent compute scheduled beside it is (up to bandwidth) free. This
+pass reads the post-SPMD HLO and classifies every collective:
 
 - **async pairs** — ``all-gather-start``/``all-gather-done``,
   ``all-reduce-start``/``-done``, ``collective-permute-start``/``-done``:
@@ -14,18 +13,32 @@ HLO and classifies every collective:
   **overlapped** when at least one real compute op that does *not* depend on
   the start sits between them in instruction order, else **serialized** (the
   consumer is right behind the start — the async form bought nothing).
-- **sync ops** — plain ``all-reduce(...)`` etc. (XLA:CPU emits only these):
-  serialized by definition.
+- **sync ops** — plain ``all-reduce(...)`` etc. In a *scheduled* module
+  (``is_scheduled=true``) the walk measures the op's **ready-window**: the
+  instructions between its last dependency (when its inputs exist — the
+  earliest the transfer can be in flight) and its first dependent consumer
+  (when the program must have the result). The op is **overlapped** when at
+  least one compute op inside that window is neither an ancestor nor a
+  descendant of it — work that can genuinely execute while the transfer
+  runs. This is how overlap manifests for sync HLO forms: XLA:CPU's thunk
+  executor runs the thunk DAG concurrently (a collective launches when its
+  inputs are ready, regardless of its position in the list schedule — the
+  list scheduler sinks every collective to just before its consumer, so
+  naive post-issue distance would read 0 for everything), and XLA:TPU/GPU
+  realize the same window by hoisting the start in their latency-hiding
+  schedulers. A sync collective whose window holds no independent compute —
+  produced late, consumed immediately, nothing concurrent-eligible between —
+  serializes on every runtime. In an UNSCHEDULED module sync ops stay
+  serialized-by-definition: instruction order proves nothing there.
 
 The observable is ``serialized_comm_bytes`` — result bytes of every
 serialized collective, i.e. the payload sitting on the critical path. This
-is the number the ZeRO-style weight-update sharding + overlap work (ROADMAP;
+is the number the ZeRO-style weight-update sharding (parallel/zero.py;
 arXiv:2004.13336, SimpleFSDP arXiv:2411.00284) exists to move, and the
 contract gate (contracts.py) pins so it cannot regress silently afterwards.
-
-Classification reads instruction order, which is execution order when the
-module is scheduled (``is_scheduled=true`` in the header — recorded in the
-summary) and a topological-order approximation otherwise.
+``overlapped_count`` (also pinned) counts both async pairs and scheduled
+sync ops that the walk proved overlapped; ``sync_overlapped_count`` breaks
+out the sync share so a contract diff shows which mechanism moved.
 """
 
 from __future__ import annotations
@@ -127,11 +140,12 @@ def collective_schedule(text: str) -> dict:
     """Classify every collective in a post-SPMD HLO text. Returns the
     schedule summary (see module docstring); ``collectives`` lists each op
     with its classification for the report's jsonl sink."""
+    scheduled = "is_scheduled=true" in text
     ops: list[dict] = []
     for lines in _computations(text):
         # parse each line exactly once — the overlap walk below revisits
-        # later instructions per async start, and a real overlap-heavy FSDP
-        # module has hundreds of starts over very long HLO texts
+        # later instructions per collective, and a real overlap-heavy FSDP
+        # module has hundreds of them over very long HLO texts
         defs = []
         for l in lines:
             m = _DEF_RE.match(l)
@@ -139,19 +153,91 @@ def collective_schedule(text: str) -> dict:
                 defs.append((None, l, "", ()))
             else:
                 defs.append((m.group(1), l, _opcode_of(l), _operands_of(l)))
-        for idx, (name, line, opcode, _) in enumerate(defs):
+        index_of = {d[0]: i for i, d in enumerate(defs) if d[0] is not None}
+        # "input-like" values exist (or are pure layout shuffles of values
+        # that exist) before any compute runs: parameters, constants, and
+        # data-movement chains over them. A collective depending only on
+        # these is ready at t=0 wherever the list scheduler placed the defs.
+        input_like: set[str] = set()
+        for d_name, _d_line, d_op, d_oprs in defs:
+            if d_name is None:
+                continue
+            if d_op in ("parameter", "constant", "iota"):
+                input_like.add(d_name)
+            elif d_op in _NON_COMPUTE and d_oprs and all(o in input_like for o in d_oprs):
+                input_like.add(d_name)
+        for idx, (name, line, opcode, my_operands) in enumerate(defs):
             if name is None:
                 continue
             kind = _SYNC_OPS.get(opcode)
             if kind is not None:
+                # ready-window walk (module docstring): ops between the
+                # collective's last dependency and its first consumer that
+                # are neither its ancestors nor its descendants can execute
+                # while the transfer is in flight.
+                overlap_ops = 0
+                consumer_found = False
+                if scheduled:
+                    last_dep = max(
+                        (
+                            index_of[o]
+                            for o in my_operands
+                            if o in index_of and o not in input_like
+                        ),
+                        default=-1,
+                    )
+                    # ancestors: reverse transitive-dependency walk, so
+                    # upstream producers inside the window are not credited
+                    needed = set(my_operands)
+                    ancestors: set[int] = set()
+                    for j in range(idx - 1, last_dep, -1):
+                        j_name = defs[j][0]
+                        if j_name is not None and j_name in needed:
+                            ancestors.add(j)
+                            needed.update(defs[j][3])
+                    # the consumer that ends the window is the first REAL
+                    # dependent op: pure data movement (layout copies, the
+                    # tuple feeding a while loop) extends the transfer chain
+                    # and taints onward instead of closing the window
+                    tainted = {name}
+                    tainted_idx: set[int] = set()
+                    consumer_idx = None
+                    for j in range(idx + 1, len(defs)):
+                        later_name, _l, later_opcode, operands = defs[j]
+                        if later_name is None:
+                            continue
+                        if any(o in tainted for o in operands):
+                            if later_opcode in _NON_COMPUTE:
+                                tainted.add(later_name)
+                                tainted_idx.add(j)
+                                continue
+                            consumer_idx = j
+                            consumer_found = True
+                            break
+                    if consumer_found:
+                        for j in range(last_dep + 1, consumer_idx):
+                            if j == idx or j in ancestors or j in tainted_idx:
+                                continue
+                            j_opcode = defs[j][2]
+                            if (
+                                j_opcode
+                                and j_opcode not in _NON_COMPUTE
+                                and j_opcode not in _SYNC_OPS
+                                and j_opcode not in _ASYNC_START
+                                and not j_opcode.endswith("-done")
+                            ):
+                                overlap_ops += 1
                 ops.append(
                     {
                         "kind": kind,
                         "name": name,
                         "bytes": sync_result_bytes(line),
                         "async": False,
-                        "overlapped": False,
-                        "overlap_compute_ops": 0,
+                        # a never-consumed result feeds the output tuple: the
+                        # NEXT program's first use is immediately behind it,
+                        # so no overlap is credited for trailing collectives
+                        "overlapped": consumer_found and overlap_ops > 0,
+                        "overlap_compute_ops": overlap_ops if consumer_found else 0,
                     }
                 )
                 continue
@@ -215,10 +301,13 @@ def collective_schedule(text: str) -> dict:
             entry["serialized_bytes"] += op["bytes"]
             serialized_bytes += op["bytes"]
     return {
-        "scheduled": "is_scheduled=true" in text,
+        "scheduled": scheduled,
         "total_count": len(ops),
         "async_count": sum(1 for op in ops if op["async"]),
         "overlapped_count": sum(1 for op in ops if op["overlapped"]),
+        "sync_overlapped_count": sum(
+            1 for op in ops if op["overlapped"] and not op["async"]
+        ),
         "serialized_count": sum(1 for op in ops if not op["overlapped"]),
         "overlapped_comm_bytes": overlapped_bytes,
         "serialized_comm_bytes": serialized_bytes,

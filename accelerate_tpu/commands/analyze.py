@@ -11,8 +11,12 @@ Three modes that compose:
        accelerate-tpu analyze train.py my_pkg/ --strict
 
 2. **Self-check** (``--self-check``): build the repo's own canonical
-   programs — the bert-tiny fused step, a llama-tiny FSDP step (sharded
-   intent, the comm/compute-overlap baseline), a llama-tiny serving engine
+   programs — the bert-tiny fused step and a llama-tiny FSDP step (both
+   compile the ZeRO sharded-update variant by default: all-gather →
+   forward/backward → reduce-scatter → sharded adamw, parallel/zero.py —
+   with sharded intent, so optimizer state resolving to replication is an
+   ERROR, and the collective-overlap schedule as the gated observable), a
+   llama-tiny serving engine
    (paged decode + every prefill chunk-span program — built with request
    tracing ATTACHED, so the gate doubles as proof that tracing adds zero
    device-program drift), and the routed 2-replica decode path — and run

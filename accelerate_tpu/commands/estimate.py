@@ -46,6 +46,12 @@ def register_subcommand(subparsers):
         help="Tokens per KV page for the paged-pool estimate (the serving "
         "engine's default layout); the dense slab is printed for comparison",
     )
+    parser.add_argument(
+        "--replicas", type=int, default=8,
+        help="Data-parallel replicas for the ZeRO column: optimizer state + "
+        "gradient bytes PER CHIP when the update is sharded (the default "
+        "training path on a multi-chip mesh)",
+    )
     parser.set_defaults(func=run)
     return parser
 
@@ -228,8 +234,17 @@ def run(args) -> int:
         )
         print(f"KV cache: {reason}, skipping")
 
+    # ZeRO column: the sharded update (parallel/zero.py — the default training
+    # path on a multi-chip mesh) holds 1/N of the optimizer state and reduced
+    # gradient per chip, so the train budget that used to be 4 bytes/param of
+    # state per chip becomes 12/N + params — visible here BEFORE anyone runs a
+    # step, same as the KV column prices serving.
+    from ..parallel.zero import zero_update_state_bytes
+
+    replicas = max(int(getattr(args, "replicas", 1) or 1), 1)
+    zero_col = f" | {f'+adam/chip @{replicas} (ZeRO)':>22}" if replicas > 1 else ""
     kv_col = f" | {'+kv (serve)':>12}" if kv_fn is not None else ""
-    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}{kv_col}"
+    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}{zero_col}{kv_col}"
     print(header)
     print("-" * len(header))
     for dtype in args.dtypes:
@@ -238,8 +253,19 @@ def run(args) -> int:
         # grads stored in the same dtype; Adam keeps two fp32 moments + fp32 master params
         train = params + n * b + n * 4 * 3
         row = f"{dtype:>10} | {_convert_bytes(params):>10} | {_convert_bytes(params * 2):>10} | {_convert_bytes(train):>14}"
+        if replicas > 1:
+            opt_chip, grad_chip = zero_update_state_bytes(n, b, replicas)
+            # params are stored sharded too under ZeRO, but the forward
+            # gathers them, so the per-chip working set still prices them full
+            row += f" | {_convert_bytes(params + grad_chip + opt_chip):>22}"
         if kv_fn is not None:
             serve = params + kv_fn(4 if dtype == "float32" else 2)
             row += f" | {_convert_bytes(serve):>12}"
         print(row)
+    if replicas > 1:
+        print(
+            f"ZeRO column: optimizer state (12 B/param fp32) and gradients "
+            f"sharded 1/{replicas} per chip; reduce-scatter -> sharded adamw "
+            f"-> all-gather (docs/performance.md)"
+        )
     return 0

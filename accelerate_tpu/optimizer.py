@@ -160,7 +160,19 @@ class AcceleratedOptimizer:
             self.growth_tracker = None
 
         self._add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b), donate_argnums=(0,))
-        self._update_fn = None  # built lazily per clip setting
+        # update programs keyed by (clip settings, sharding fingerprint): the
+        # program bakes in the clip constants AND the state layout (output
+        # constraints + donation aliasing are functions of the shardings), so
+        # an optimizer whose shardings change — a model re-prepared on a
+        # different mesh, a ZeRO layout swapped in — must trace a fresh
+        # program instead of reusing a wrong-donation / wrong-shard one.
+        self._update_fns: dict = {}
+        # fingerprint memo: (params_shardings, opt_shardings, fingerprint) —
+        # compared by IDENTITY (strong refs, so ids can't be recycled); the
+        # specs only change when the trees are reassigned (re-prepare, ZeRO
+        # layout swap), so the hot path pays a tuple compare, not a tree walk
+        self._fingerprint_memo: Optional[tuple] = None
+        self._zeros_fn_memo: Optional[tuple] = None  # audit-path zeros builder
         self._pending_clip_norm = clip_grad_norm
         self._pending_clip_value = None
 
@@ -183,14 +195,78 @@ class AcceleratedOptimizer:
         return jax.tree.map(lambda g: g.astype(jnp.float32) / (count * scale), self._grads)
 
     def set_clip_grad_norm(self, max_norm: Optional[float]) -> None:
-        if max_norm != self._pending_clip_norm:
-            self._pending_clip_norm = max_norm
-            self._update_fn = None  # different constant → recompile
+        self._pending_clip_norm = max_norm  # part of the jit-cache key
 
     def set_clip_grad_value(self, clip_value: Optional[float]) -> None:
-        if clip_value != self._pending_clip_value:
-            self._pending_clip_value = clip_value
-            self._update_fn = None  # different constant → recompile
+        self._pending_clip_value = clip_value  # part of the jit-cache key
+
+    def _sharding_fingerprint(self) -> tuple:
+        """Hashable identity of the state layout the update program is traced
+        against: mesh shape + every param/opt-state PartitionSpec. Two
+        optimizers (or one rebound across meshes) with different layouts can
+        never share a compiled update through an equal clip key."""
+        memo = self._fingerprint_memo
+        if (
+            memo is not None
+            and memo[0] is self._params_shardings
+            and memo[1] is self._opt_state_device_shardings
+        ):
+            return memo[2]
+
+        def _specs(tree) -> tuple:
+            return tuple(str(s.spec) for s in jax.tree.leaves(tree))
+
+        mesh = self.accelerator_state.mesh
+        fingerprint = (
+            tuple(sorted((str(k), int(v)) for k, v in mesh.shape.items())),
+            _specs(self._params_shardings),
+            _specs(self._opt_state_device_shardings),
+        )
+        self._fingerprint_memo = (
+            self._params_shardings,
+            self._opt_state_device_shardings,
+            fingerprint,
+        )
+        return fingerprint
+
+    def _update_key(self) -> tuple:
+        return (
+            self._pending_clip_norm,
+            self._pending_clip_value,
+            self._sharding_fingerprint(),
+        )
+
+    _UPDATE_FN_CACHE_LIMIT = 8
+
+    def _current_update_fn(self):
+        """The compiled update for the CURRENT clip settings and sharding
+        layout, building (and consulting the donation audit) on a miss. The
+        cache is bounded: a clip schedule feeding a fresh float every step
+        must not retain every compiled program it ever built (same guard as
+        Accelerator's grad-fn cache)."""
+        key = self._update_key()
+        fn = self._update_fns.get(key)
+        if fn is not None:
+            # LRU: re-insert the hit so clip-key churn evicts the coldest
+            # program, never the every-step one
+            self._update_fns[key] = self._update_fns.pop(key)
+        else:
+            if len(self._update_fns) >= self._UPDATE_FN_CACHE_LIMIT:
+                evicted = next(iter(self._update_fns))
+                del self._update_fns[evicted]
+                from .logging import get_logger
+
+                get_logger(__name__).warning_once(
+                    "optimizer.step() has compiled more than "
+                    f"{self._UPDATE_FN_CACHE_LIMIT} distinct update programs — "
+                    "a clip value that changes every step recompiles every "
+                    "step; prefer a fixed clip (or step the schedule less "
+                    "often)."
+                )
+            fn = self._update_fns[key] = self._build_update_fn()
+            if self.telemetry is not None:
+                self._consult_donation()
+        return fn
 
     # -- the update --------------------------------------------------------
 
@@ -237,23 +313,38 @@ class AcceleratedOptimizer:
 
         return jax.jit(update, donate_argnums=(0, 1, 2), static_argnums=(3,))
 
+    def _zeros_like_params(self):
+        """Zero gradients laid out like the params (the audit path's grads
+        stand-in). The jitted builder is cached per shardings object — a
+        fresh lambda per call would miss jax's jit cache (keyed on function
+        identity) and recompile on every audit lowering."""
+        memo = self._zeros_fn_memo
+        if memo is None or memo[0] is not self._params_shardings:
+            fn = jax.jit(
+                lambda: jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), self._box.value
+                ),
+                out_shardings=self._params_shardings,
+            )
+            memo = self._zeros_fn_memo = (self._params_shardings, fn)
+        return memo[1]()
+
     # -- donation audit (analysis/program.py) --------------------------------
 
     def _lower_update(self):
         """AOT-lower the current update program against live state (grads
         substituted with zeros when none are accumulated) — the donation
-        audit's view of exactly what ``step()`` runs."""
-        if self._update_fn is None:
-            self._update_fn = self._build_update_fn()
+        audit's view of exactly what ``step()`` runs. Under ZeRO the zero
+        grads are laid out like the params (the sharded storage layout), so
+        the audited program is the sharded update, aliasing and all."""
+        update_fn = self._current_update_fn()
         grads = self._grads
         if grads is None:
-            grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), self._box.value
-            )
+            grads = self._zeros_like_params()
         opt_state = self.opt_state
         if self.cpu_offload:
             opt_state = jax.device_put(opt_state, self._opt_state_device_shardings)
-        return self._update_fn.lower(
+        return update_fn.lower(
             self._box.value, opt_state, grads, int(self._accum_count or 1),
             self.scale, self.growth_tracker,
         )
@@ -294,10 +385,7 @@ class AcceleratedOptimizer:
     def step(self) -> None:
         if not self.gradient_state.sync_gradients or self._grads is None:
             return
-        if self._update_fn is None:
-            self._update_fn = self._build_update_fn()
-            if self.telemetry is not None:
-                self._consult_donation()
+        update_fn = self._current_update_fn()
         if self.cpu_offload:
             # stream offloaded state into device memory for the update (the jit
             # itself stays all-device: mixing memory spaces inside a traced
@@ -310,7 +398,7 @@ class AcceleratedOptimizer:
             growth,
             self._skipped,
             self._last_grad_norm,
-        ) = self._update_fn(
+        ) = update_fn(
             self._box.value, self.opt_state, self._grads, int(self._accum_count),
             self.scale, self.growth_tracker,
         )

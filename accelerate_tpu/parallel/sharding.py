@@ -176,6 +176,90 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+# -- ZeRO update-sharding specs (arXiv 2004.13336; SimpleFSDP 2411.00284) ------
+#
+# The weight update is elementwise, so it decomposes exactly across any
+# partition of the parameters: reduce-scatter the gradients over the
+# data-parallel axes, update each chip's 1/N shard with 1/N optimizer state,
+# and all-gather the result where the next forward needs it. These helpers
+# produce the *storage* layout that decomposition implies: each parameter's
+# PartitionSpec with the ZeRO axes folded onto a divisible dimension.
+
+
+def zero_batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The data-parallel axes a ZeRO update shards over — every nontrivial
+    batch axis (the axes ``AcceleratorState.data_sharding`` splits over)."""
+    from ..utils.constants import MESH_AXIS_DATA
+
+    return tuple(
+        a for a in (MESH_AXIS_DATA, MESH_AXIS_FSDP) if mesh.shape.get(a, 1) > 1
+    )
+
+
+def _spec_axes(spec) -> set:
+    axes = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in entry if isinstance(entry, tuple) else (entry,):
+            axes.add(a)
+    return axes
+
+
+def fold_update_spec(
+    shape: tuple[int, ...], spec, mesh: Mesh, zero_axes: Sequence[str]
+) -> PartitionSpec:
+    """Fold ``zero_axes`` into ``spec``: split one more dimension of the
+    parameter over the update axes (preferring a dim that is already sharded —
+    the reduce-scatter then extends the existing split — else the largest
+    divisible free dim). Axes already present in the spec are skipped; a
+    parameter with no divisible dim keeps its spec (its update runs
+    replicated — bias-vector sized, so the state saving is negligible)."""
+    spec = tuple(spec) + (None,) * (len(shape) - len(spec))
+    fold = tuple(a for a in zero_axes if a not in _spec_axes(spec))
+    zsize = 1
+    for a in fold:
+        zsize *= mesh.shape[a]
+    if zsize == 1 or not shape:
+        return PartitionSpec(*spec)
+
+    def _axis_count(entry) -> int:
+        if entry is None:
+            return 1
+        if isinstance(entry, tuple):
+            return _axis_size(mesh, entry)
+        return _axis_size(mesh, (entry,))
+
+    order = sorted(
+        range(len(shape)), key=lambda i: (_axis_count(spec[i]) == 1, -shape[i])
+    )
+    for dim in order:
+        if shape[dim] % (_axis_count(spec[dim]) * zsize) == 0:
+            base = (
+                spec[dim]
+                if isinstance(spec[dim], tuple)
+                else ((spec[dim],) if spec[dim] is not None else ())
+            )
+            folded = list(spec)
+            merged = tuple(base) + fold
+            folded[dim] = merged if len(merged) > 1 else merged[0]
+            return PartitionSpec(*folded)
+    return PartitionSpec(*spec)
+
+
+def zero_update_shardings(tree: Any, shardings: Any, mesh: Mesh) -> Any:
+    """Param tree + its NamedShardings → the ZeRO-folded NamedShardings (the
+    storage layout for parameters, gradients shards, and optimizer moments)."""
+    axes = zero_batch_axes(mesh)
+
+    def _leaf(leaf, sharding):
+        return NamedSharding(
+            mesh, fold_update_spec(tuple(leaf.shape), sharding.spec, mesh, axes)
+        )
+
+    return jax.tree.map(_leaf, tree, shardings)
+
+
 def shardings_like(state_shapes: Any, params: Any, params_shardings: Any, mesh: Mesh) -> Any:
     """Shardings for an optimizer-state tree: leaves that are param-tree copies
     (Adam moments) reuse the matching param's sharding; everything else is

@@ -1,0 +1,435 @@
+"""ZeRO-style sharded weight update: reduce-scatter → sharded adamw → all-gather.
+
+The replicated data-parallel update makes every chip do the same work on the
+same bytes: all-reduce the full gradient, hold a full copy of the optimizer
+state, apply the full update. ZeRO (arXiv 2004.13336) observes the update is
+*elementwise*, so it decomposes exactly: reduce-scatter gradients over the
+data-parallel axes (each chip receives the fully-reduced values for its 1/N
+shard — same wire bytes as the all-reduce's reduce phase, 1/N the critical-
+path payload), run the optimizer on that shard with 1/N optimizer state, and
+all-gather parameters where the next forward consumes them. SimpleFSDP
+(arXiv 2411.00284) lands the same decomposition compiler-side.
+
+This module builds that step as ONE fused program over a fully-manual
+``shard_map`` region, because GSPMD cannot be coaxed into it on every
+backend: with auto partitioning, a sharded-update constraint lowers to
+all-reduce + dynamic-slice on backends without a reduce-scatter creation
+pass (XLA:CPU — measured, not assumed), which keeps the full gradient on the
+critical path. Explicit ``psum_scatter`` / ``all_gather`` emit the real
+collectives everywhere. Parameters are *stored* in the folded 1/N layout
+(`sharding.zero_update_shardings`), so each step opens with the all-gathers
+for its own forward — scheduled at the top of the program where every later
+layer's compute is independent work for them to hide behind, which is where
+the latency-hiding the schedule pass (analysis/schedule.py) verifies comes
+from — and closes with the reduce-scatter + sharded update, leaving the
+updated shards in place for the next step to gather.
+
+Bit-exactness (pinned by tests/test_zero.py): every rescale the
+decomposition introduces is a power-of-two (device counts, loss scales), so
+scaling commutes exactly through the linear backward and the rank-ordered
+collective reductions; the sharded update is then elementwise-identical to
+the replicated one. The gradient *computation* itself is traced per-device
+instead of auto-partitioned, which XLA may fuse differently — reassociation-
+level (last-bit) differences, same as any compiler version bump. (On this
+container's XLA:CPU the manual program is in fact the *more* faithful one:
+the auto-partitioned fused FSDP step returns a loss that deviates from the
+float64 reference by ~4e-3 relative, the manual program by <1e-7 —
+tests/test_zero.py pins the f64 anchor.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.constants import (
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_PIPELINE,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
+from .sharding import zero_batch_axes
+
+# mesh axes that carry *model* parallelism: the manual region would have to
+# re-implement their collectives (TP partial sums, ring attention, pipeline
+# schedules), so ZeRO auto-enables only when they are all trivial
+_MODEL_AXES = (MESH_AXIS_TENSOR, MESH_AXIS_SEQUENCE, MESH_AXIS_PIPELINE, MESH_AXIS_EXPERT)
+
+
+def zero_eligible(mesh: Mesh, fsdp_plugin=None) -> bool:
+    """Whether the ZeRO sharded update can replace the replicated one on this
+    mesh: at least one nontrivial data-parallel axis, no model-parallel axes
+    (their collectives live inside the auto-partitioned forward), and no
+    legacy stage-1/2 FSDP or cpu-offload configuration (those keep params
+    replicated / state in host RAM by explicit contract)."""
+    if not zero_batch_axes(mesh):
+        return False
+    if any(mesh.shape.get(a, 1) > 1 for a in _MODEL_AXES):
+        return False
+    if fsdp_plugin is not None and (fsdp_plugin.stage < 3 or fsdp_plugin.cpu_offload):
+        return False
+    return True
+
+
+def tx_couples_across_leaves(tx, params_tree: Any) -> bool:
+    """Probe whether an optax transform couples gradient leaves — the
+    property that breaks the ZeRO decomposition. The sharded update runs
+    ``tx`` on 1/N shards, which is exact only for elementwise transforms
+    (adam/sgd families); a transform that reads ACROSS leaves (an
+    ``optax.clip_by_global_norm`` inside the chain) would compute its
+    reduction over the local shard and silently train differently. The probe
+    runs two updates on a tiny surrogate tree with the real tree's
+    STRUCTURE (so path/label-keyed transforms behave normally), bumping a
+    single element of the last leaf, and reports coupling if anything the
+    bump cannot reach elementwise moved: the first leaf's update (cross-leaf
+    coupling — a chained clip_by_global_norm) or the last leaf's OTHER
+    element (within-leaf reductions — LAMB/LARS trust ratios, adafactor's
+    RMS clipping). Costs two (2,)-element updates at prepare time; probe
+    failures (exotic transforms that reject the surrogate) report False —
+    the documented contract still applies."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(params_tree)
+    if not leaves:
+        return False
+    try:
+        tiny = jax.tree_util.tree_unflatten(
+            treedef, [jnp.ones((2,), jnp.float32) for _ in leaves]
+        )
+        base = jax.tree_util.tree_unflatten(
+            treedef, [jnp.full((2,), 0.5, jnp.float32) for _ in leaves]
+        )
+        bumped_leaves = [jnp.full((2,), 0.5, jnp.float32) for _ in leaves]
+        bumped_leaves[-1] = jnp.asarray([0.5, 64.0], jnp.float32)
+        bumped = jax.tree_util.tree_unflatten(treedef, bumped_leaves)
+        # advance the state one step first: several transforms normalize the
+        # very first update into a shape-independent form (adafactor's
+        # g/sqrt(g^2) = ±1), which would blind a from-init probe
+        _, state = tx.update(base, tx.init(tiny), tiny)
+        up_a, _ = tx.update(base, state, tiny)
+        up_b, _ = tx.update(bumped, state, tiny)
+        flat_a = jax.tree_util.tree_leaves(up_a)
+        flat_b = jax.tree_util.tree_leaves(up_b)
+        if not np.array_equal(np.asarray(flat_a[-1])[0], np.asarray(flat_b[-1])[0]):
+            return True  # within-leaf reduction reached the un-bumped element
+        return len(leaves) > 1 and not np.array_equal(
+            np.asarray(flat_a[0]), np.asarray(flat_b[0])
+        )
+    except Exception as e:
+        # an unprobeable transform is NOT proven elementwise — say so where
+        # someone will look instead of silently reporting "no coupling"
+        from ..logging import get_logger
+
+        get_logger(__name__).warning(
+            f"ZeRO elementwise-update probe could not run on "
+            f"{type(tx).__name__} ({e!r}); proceeding on the documented "
+            "contract that the transform is elementwise — if it reduces "
+            "across gradient elements, pass ParallelismConfig(zero_stage=0)."
+        )
+        return False
+
+
+def _sharded_dims(spec, mesh: Optional[Mesh] = None) -> list[tuple[int, tuple[str, ...]]]:
+    """(dim, axes) pairs for a PartitionSpec. With a mesh, size-1 axes are
+    dropped: a collective over a trivial axis is an exact no-op, but XLA
+    still materializes it as a singleton-group op that pollutes the
+    collective inventory and the schedule pass."""
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        if mesh is not None:
+            axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        if axes:
+            out.append((dim, axes))
+    return out
+
+
+def gather_full(x: jax.Array, spec, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Inside the manual region: local param shard → full parameter, one
+    tiled all-gather per sharded dim (axis-tuple order matches the
+    PartitionSpec split order, so this is the exact inverse of the storage
+    placement)."""
+    for dim, axes in _sharded_dims(spec, mesh):
+        x = jax.lax.all_gather(x, axes, axis=dim, tiled=True)
+    return x
+
+
+def make_grad_reducer(pspecs: Any, batch_axes: tuple[str, ...], mesh: Optional[Mesh] = None):
+    """Returns ``reduce(grads_tree) -> shard_tree``: per-leaf reduce-scatter
+    into the parameter's storage layout (summing over the batch axes), with a
+    plain psum for leaves whose spec consumed no batch axis (the un-foldable
+    small leaves — their update stays replicated). Gradients must already
+    carry the 1/N batch prescale: the scatter then sums exactly the terms the
+    replicated all-reduce would."""
+
+    def _leaf(g, spec):
+        consumed: list[str] = []
+        for dim, axes in _sharded_dims(spec, mesh):
+            if any(a in batch_axes for a in axes):
+                g = jax.lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True)
+                consumed.extend(a for a in axes if a in batch_axes)
+        rest = tuple(a for a in batch_axes if a not in consumed)
+        if rest:
+            g = jax.lax.psum(g, rest)
+        return g
+
+    return lambda grads: jax.tree.map(_leaf, grads, pspecs)
+
+
+def sharded_global_norm(grads: Any, pspecs: Any, batch_axes: tuple[str, ...], mesh: Mesh):
+    """Global L2 norm of a gradient tree living in the storage layout. A
+    leaf's elements are disjoint across the batch axes its spec consumed and
+    REPLICATED across the ones it didn't (partially-folded leaves exist: a
+    dim divisible by fsdp but not by fsdp×data keeps only the fsdp split),
+    so one uniform psum over all batch axes counts each element
+    prod(missing axes) times. Pre-dividing each leaf's square-sum by that
+    count — a power of two, and summing identical copies is exact scaling —
+    makes the single psum come out as exactly one copy of every element."""
+    total = jnp.float32(0.0)
+    for g, spec in zip(
+        jax.tree.leaves(grads), jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P))
+    ):
+        consumed = {
+            a for _, axes in _sharded_dims(spec, mesh) for a in axes if a in batch_axes
+        }
+        copies = 1
+        for a in batch_axes:
+            if a not in consumed:
+                copies *= mesh.shape[a]
+        contrib = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        total = total + (contrib / copies if copies > 1 else contrib)
+    if batch_axes:
+        total = jax.lax.psum(total, batch_axes)
+    return jnp.sqrt(total)
+
+
+def build_zero_step(
+    *,
+    mesh: Mesh,
+    loss_fn: Callable,
+    tx,
+    params_shardings: Any,
+    opt_state_shardings: Any,
+    batch_sharding,
+    compute_cast: Callable,
+    num_micro: int = 1,
+    remat_policy=None,
+    scaler_cfg=None,
+    clip_grad_norm: Optional[float] = None,
+    clip_grad_value: Optional[float] = None,
+    guard_policy=None,
+    chaos_nan_target: Optional[str] = None,
+    resilience_on: bool = False,
+    donate: bool = True,
+):
+    """The fused ZeRO train-step program.
+
+    Signature-compatible with ``Accelerator.compiled_step``'s jitted program:
+    ``(params, opt_state, batch, scale, growth_tracker)`` — plus
+    ``(guard_state, corrupt)`` when ``guard_policy``/``chaos_nan_target`` arm
+    the resilience path — so the step/lower wrappers, donation audit, and
+    contracts treat both implementations as one program family. Parameters
+    and optimizer state enter AND leave in the folded storage layout; the
+    program opens with their all-gathers (hidden behind forward compute) and
+    closes with the gradient reduce-scatter + sharded update.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from ..optimizer import clip_by_value as _clip_by_value
+    from ..optimizer import scaled_optimizer_update
+    from ..resilience.guards import next_guard_state
+
+    batch_axes = zero_batch_axes(mesh)
+    pspecs = jax.tree.map(lambda s: s.spec, params_shardings)
+    ospecs = jax.tree.map(lambda s: s.spec, opt_state_shardings)
+    batch_spec = batch_sharding.spec
+    n_batch_shards = 1
+    for a in batch_axes:
+        n_batch_shards *= mesh.shape[a]
+    reduce_grads = make_grad_reducer(pspecs, batch_axes, mesh)
+    # the guarded program shape follows the HUB's armed state (signature
+    # parity with the replicated path: gstate/corrupt thread through even
+    # when only chaos stalls are scheduled), not just our own knobs
+    res_on = resilience_on or guard_policy is not None or chaos_nan_target is not None
+
+    def gather_all(params):
+        return jax.tree.map(lambda p, s: gather_full(p, s, mesh), params, pspecs)
+
+    def loss_of(full_params, local_batch, scale):
+        fn = loss_fn
+        if remat_policy is not None:
+            fn = jax.checkpoint(fn, policy=remat_policy)
+        loss = fn(compute_cast(full_params), compute_cast(local_batch))
+        # 1/N batch-shard factor applied in the loss's NATIVE dtype, before
+        # the f32 cast and the scale multiply — the replicated program's
+        # global mean puts its 1/batch inside the compute-dtype region too,
+        # so the backward sees identical cotangent magnitudes at every cast
+        # boundary. That parity is what keeps GradScaler dynamics intact: the
+        # f32→fp16 boundary must see the RAW scale (whose deliberate overflow
+        # is the scaler's backoff probe), and since N and the scale are
+        # powers of two the values match the replicated path bit-exactly.
+        # scale stays a STATIC None without a scaler (same elision as the
+        # replicated path).
+        if n_batch_shards > 1:
+            loss = loss / n_batch_shards
+        loss = loss.astype(jnp.float32)
+        return loss if scale is None else loss * scale
+
+    def local_loss_and_grads(params, batch, scale):
+        import math
+
+        full = gather_all(params)
+        # the region sees the LOCAL batch shard (1/N of the rows), so the
+        # accumulation window's memory-saving split is re-derived locally:
+        # the largest divisor of the local rows that fits num_micro. Equal-
+        # size microbatch accumulation is a mean, so ANY split factor gives
+        # the same gradients — only the activation working set changes (a
+        # window of 4 over 8 global rows on 8 chips is 1 local row: nothing
+        # left to split, one pass).
+        rows = int(jax.tree.leaves(batch)[0].shape[0])
+        eff_micro = math.gcd(num_micro, rows) if num_micro > 1 else 1
+        if eff_micro > 1:
+            def micro(carry, mb):
+                grads_acc, loss_acc = carry
+                loss, grads = jax.value_and_grad(loss_of)(full, mb, scale)
+                return (jax.tree.map(jnp.add, grads_acc, grads), loss_acc + loss), None
+
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), full)
+            micro_batches = jax.tree.map(
+                lambda x: x.reshape((eff_micro, x.shape[0] // eff_micro) + x.shape[1:]),
+                batch,
+            )
+            (grads, loss), _ = jax.lax.scan(micro, (zeros, jnp.float32(0.0)), micro_batches)
+            grads = jax.tree.map(lambda g: g / eff_micro, grads)
+            loss = loss / eff_micro
+            return loss, grads
+        return jax.value_and_grad(loss_of)(full, batch, scale)
+
+    def prescale(grads, scale):
+        # unscale BEFORE the reduce-scatter (the 1/N mean already rode the
+        # loss multiplier): the scatter then sums exactly the g_i terms the
+        # replicated all-reduce sums, and every factor is a power of two
+        if scale is None:
+            return grads
+        return jax.tree.map(lambda g: g / scale, grads)
+
+    def finish(loss, scale):
+        # the 1/N loss factor makes the psum over shards the global mean
+        loss = jax.lax.psum(loss, batch_axes)
+        return loss if scale is None else loss / scale
+
+    def step_impl(params, opt_state, batch, scale, growth_tracker):
+        loss, grads = local_loss_and_grads(params, batch, scale)
+        grads = reduce_grads(prescale(grads, scale))
+        grads = _clip_by_value(grads, clip_grad_value)
+        gnorm = None
+        if clip_grad_norm is not None or scaler_cfg is not None:
+            gnorm = sharded_global_norm(grads, pspecs, batch_axes, mesh)
+            if clip_grad_norm is not None:
+                factor = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+        loss = finish(loss, scale)
+        params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
+            tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
+        )
+        return params, opt_state, loss, scale, growth_tracker, skipped
+
+    # NOTE: this guard ladder (chaos poison → verdict → escalate clip →
+    # skip-cond with scaler backoff → guard-state advance) deliberately
+    # mirrors Accelerator.compiled_step's replicated guarded_step_impl —
+    # only the norm (sharded) and the loss finish (psum) differ. A semantic
+    # change to skip/escalate/backoff belongs in BOTH places; the resilience
+    # test suite runs each path against the same expectations.
+    def guarded_step_impl(params, opt_state, batch, scale, growth_tracker, gstate, corrupt):
+        loss, grads = local_loss_and_grads(params, batch, scale)
+        if chaos_nan_target is not None:
+            poison = jnp.where(corrupt != 0, jnp.float32(jnp.nan), jnp.float32(1.0))
+            if chaos_nan_target == "loss":
+                loss = loss * poison
+            else:
+                grads = jax.tree.map(lambda g: g * poison, grads)
+        grads = reduce_grads(prescale(grads, scale))
+        grads = _clip_by_value(grads, clip_grad_value)
+        # the guard's verdict needs the global norm regardless of clip
+        # settings — and the GLOBAL loss: the local shard-loss can be finite
+        # on some devices and not others, and a device-varying lax.cond
+        # verdict would apply the update on some shards and skip it on
+        # others. Both psums below make the verdict device-uniform.
+        loss = finish(loss, scale)
+        gnorm = sharded_global_norm(grads, pspecs, batch_axes, mesh)
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm) if guard_policy is not None else None
+        escalating = guard_policy is not None and guard_policy.escalate_clip is not None
+        if clip_grad_norm is not None or escalating:
+            base = (
+                jnp.float32(clip_grad_norm)
+                if clip_grad_norm is not None
+                else jnp.float32(jnp.inf)
+            )
+            if escalating:
+                esc = jnp.minimum(jnp.float32(guard_policy.escalate_clip), base)
+                limit = jnp.where(gstate["escalate"] > 0, esc, base)
+            else:
+                limit = base
+            factor = jnp.minimum(1.0, limit / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * factor, grads)
+        if guard_policy is not None and guard_policy.skip_nonfinite:
+            def _apply(args):
+                p, o, s, gt = args
+                return scaled_optimizer_update(tx, p, o, grads, gnorm, s, gt, scaler_cfg)
+
+            def _skip(args):
+                p, o, s, gt = args
+                if scaler_cfg is not None:
+                    s = s * scaler_cfg.backoff_factor
+                    gt = jnp.int32(0)
+                return p, o, s, gt, jnp.asarray(True)
+
+            params, opt_state, scale, growth_tracker, skipped = jax.lax.cond(
+                finite, _apply, _skip, (params, opt_state, scale, growth_tracker)
+            )
+        else:
+            params, opt_state, scale, growth_tracker, skipped = scaled_optimizer_update(
+                tx, params, opt_state, grads, gnorm, scale, growth_tracker, scaler_cfg
+            )
+        if guard_policy is not None:
+            gstate = next_guard_state(gstate, finite, guard_policy.escalate_steps)
+        return params, opt_state, loss, scale, growth_tracker, skipped, gstate
+
+    rep = P()
+    if res_on:
+        in_specs = (pspecs, ospecs, batch_spec, rep, rep, rep, rep)
+        out_specs = (pspecs, ospecs, rep, rep, rep, rep, rep)
+        impl = guarded_step_impl
+    else:
+        in_specs = (pspecs, ospecs, batch_spec, rep, rep)
+        out_specs = (pspecs, ospecs, rep, rep, rep, rep)
+        impl = step_impl
+    # check_rep can't statically infer that psum-derived outputs are
+    # replicated; the out_specs above are the semantic declaration
+    smapped = shard_map(
+        impl, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+
+# -- sizing (the estimate-memory CLI's ZeRO column) ---------------------------
+
+
+def zero_update_state_bytes(
+    n_params: int, grad_dtype_bytes: float, replicas: int
+) -> tuple[int, int]:
+    """(optimizer_state_bytes_per_chip, gradient_bytes_per_chip) for an
+    adam-family update sharded over ``replicas`` chips — the shared sizing
+    formula behind `accelerate-tpu estimate-memory`'s ZeRO column (the
+    training analogue of ``kv_cache_bytes`` for serving). Optimizer state is
+    two fp32 moments + fp32 master params; under ZeRO each chip holds 1/N of
+    both it and the reduced gradient."""
+    replicas = max(int(replicas), 1)
+    opt_full = n_params * 4 * 3
+    grad_full = int(n_params * grad_dtype_bytes)
+    return -(-opt_full // replicas), -(-grad_full // replicas)

@@ -16,9 +16,29 @@ signal on every backend.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 from ..utils.environment import get_device_memory_info, get_host_memory_info
+
+
+def state_bytes_per_chip(tree: Any) -> int:
+    """Bytes of a state pytree ONE chip holds: the per-device addressable
+    shard, not the logical array. Under the ZeRO sharded update the optimizer
+    state is 1/N of the replicated layout — this is the accounting that makes
+    the saving a telemetry/bench number (``zero_opt_state_bytes_per_chip``)
+    instead of a claim; on replicated state it degrades to the full size."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            # shards of THIS process's first device: one chip's residency
+            device = shards[0].device
+            total += sum(s.data.nbytes for s in shards if s.device == device)
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
 
 
 class MemoryMonitor:

@@ -232,6 +232,11 @@ class ParallelismConfig:
     expert: int = 1
     sequence: int = 1
     tensor: int = 1
+    # ZeRO update sharding over the data axes (parallel/zero.py): None = auto
+    # (on whenever the mesh is eligible — data-parallel axes present, no
+    # model-parallel axes), 0 = force the legacy replicated update, >=1 =
+    # require it (raises at prepare time if the mesh cannot shard the update).
+    zero_stage: Optional[int] = None
 
     @classmethod
     def from_env(cls) -> "ParallelismConfig":
@@ -242,6 +247,7 @@ class ParallelismConfig:
             expert=parse_int_from_env("ACCELERATE_EXPERT_SIZE", 1),
             sequence=parse_int_from_env("ACCELERATE_SEQUENCE_SIZE", 1),
             tensor=parse_int_from_env("ACCELERATE_TENSOR_SIZE", 1),
+            zero_stage=parse_int_from_env("ACCELERATE_ZERO_STAGE"),
         )
 
     def axis_sizes(self, num_devices: int) -> dict[str, int]:

@@ -337,8 +337,111 @@ def bench_llama_longseq() -> dict:
     )
 
 
-def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model_key=False) -> dict:
-    """Shared harness: FSDP llama training throughput + MFU at a given shape."""
+def bench_zero() -> dict:
+    """Paired replicated-vs-ZeRO window (same methodology as
+    ``resilience_guard_overhead_pct``: identical model/shape/windows, only the
+    update scheme flips via ``zero_stage``):
+
+    - ``zero_llama_train_mfu_sharded`` / ``zero_llama_train_mfu_replicated``
+      — llama FSDP MFU under the ZeRO sharded update vs the legacy one;
+    - ``zero_opt_state_bytes_per_chip_*`` — per-chip optimizer-state HBM for
+      both sides (the 1/N saving as a measured number);
+    - ``zero_update_bit_equal`` — 10 fixed-seed (temp-0) steps of IDENTICAL
+      gradients through both update paths: gathered params + optimizer state
+      must match at float tolerance 0 (the ZeRO decomposition is exact);
+    - ``zero_steady_state_compile_count`` — must be 0 for the sharded window.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import Llama
+    from accelerate_tpu.utils.random import set_seed
+
+    name = os.environ.get("BENCH_ZERO_MODEL", "llama-125m")
+    batch_size = int(os.environ.get("BENCH_ZERO_BS", "32"))
+    seq_len = int(os.environ.get("BENCH_ZERO_SEQ", "1024"))
+    n_steps = int(os.environ.get("BENCH_ZERO_STEPS", "10"))
+
+    result: dict = {}
+    for side, stage in (("sharded", None), ("replicated", 0)):
+        part = _llama_train_bench(
+            name, batch_size, seq_len, n_steps, prefix=f"zero_{side}", zero_stage=stage
+        )
+        for key in ("train_mfu", "tokens_per_sec_per_chip", "opt_state_bytes_per_chip",
+                    "steady_state_compile_count", "compile_count"):
+            if f"zero_{side}_{key}" in part:
+                result[f"zero_llama_{key}_{side}" if "mfu" in key else f"zero_{key}_{side}"] = (
+                    part[f"zero_{side}_{key}"]
+                )
+    if result.get("zero_opt_state_bytes_per_chip_sharded"):
+        result["zero_opt_state_per_chip_saving_ratio"] = round(
+            result["zero_opt_state_bytes_per_chip_replicated"]
+            / result["zero_opt_state_bytes_per_chip_sharded"],
+            2,
+        )
+
+    # -- the bit-equality gate: identical seeded gradients through both
+    # update paths, 10 steps, tolerance 0 on gathered params + opt state.
+    # Data-parallel mesh: the replicated side holds full params + state on
+    # every chip, the sharded side 1/N of both — the layouts (and compiled
+    # update programs) genuinely differ, and ZeRO's claim is that the
+    # decomposed update is exactly the replicated one.
+    from accelerate_tpu.telemetry.memory import state_bytes_per_chip
+
+    def updated_state(zero_stage, side):
+        _reset_state()
+        set_seed(0)
+        accelerator = Accelerator(
+            parallelism=ParallelismConfig(zero_stage=zero_stage),
+        )
+        model = Llama("llama-tiny")
+        prepared = accelerator.prepare_model(model)
+        optimizer = accelerator.prepare_optimizer(optax.adamw(3e-4))
+        # the DATA-PARALLEL state pairing: stage-3 FSDP (the MFU window
+        # above) already shards its moments, so the 1/N state saving shows
+        # here, where the replicated side genuinely holds everything
+        result[f"zero_dp_opt_state_bytes_per_chip_{side}"] = state_bytes_per_chip(
+            optimizer.opt_state
+        )
+        rng = np.random.default_rng(0)
+        host_params = jax.tree.map(np.asarray, prepared.params)
+        for _ in range(n_steps):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+                host_params,
+            )
+            optimizer.accumulate_grads(jax.device_put(grads, prepared.params_shardings))
+            optimizer.step()
+        return (
+            jax.tree.map(np.asarray, prepared.params),
+            jax.tree.map(np.asarray, optimizer.opt_state),
+        )
+
+    p_sharded, o_sharded = updated_state(None, "sharded")
+    p_repl, o_repl = updated_state(0, "replicated")
+    if result.get("zero_dp_opt_state_bytes_per_chip_sharded"):
+        result["zero_dp_opt_state_per_chip_saving_ratio"] = round(
+            result["zero_dp_opt_state_bytes_per_chip_replicated"]
+            / result["zero_dp_opt_state_bytes_per_chip_sharded"],
+            2,
+        )
+    params_equal = all(
+        jax.tree.leaves(jax.tree.map(np.array_equal, p_sharded, p_repl))
+    )
+    opt_equal = all(jax.tree.leaves(jax.tree.map(np.array_equal, o_sharded, o_repl)))
+    result["zero_update_bit_equal"] = bool(params_equal and opt_equal)
+    return result
+
+
+def _llama_train_bench(
+    name, batch_size, seq_len, n_steps, prefix, include_model_key=False, zero_stage=None
+) -> dict:
+    """Shared harness: FSDP llama training throughput + MFU at a given shape.
+    ``zero_stage`` passes through to ParallelismConfig (None = the default
+    auto-resolved ZeRO sharded update, 0 = legacy replicated update — the
+    two sides of the ``zero_*`` paired window)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -346,17 +449,20 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
     from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, ParallelismConfig
     from accelerate_tpu.models import Llama
     from accelerate_tpu.telemetry import CompileTracker
+    from accelerate_tpu.telemetry.memory import state_bytes_per_chip
 
     _reset_state()
     compiles = CompileTracker().start()
     accelerator = Accelerator(
         mixed_precision="bf16",
-        parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
+        parallelism=ParallelismConfig(
+            data=1, fsdp=jax.device_count(), zero_stage=zero_stage
+        ),
         fsdp_plugin=FullyShardedDataParallelPlugin(stage=3, activation_checkpointing=True),
     )
     model = Llama(name)
     accelerator.prepare_model(model)
-    accelerator.prepare_optimizer(optax.adamw(3e-4))
+    optimizer = accelerator.prepare_optimizer(optax.adamw(3e-4))
 
     def loss_fn(params, batch):
         # logsumexp-form cross-entropy: never materializes the [B,S,V] fp32
@@ -379,6 +485,7 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
     for _ in range(3):
         loss = step(batch)
     float(loss)
+    compiles_before_window = compiles.compile_count
     steps_per_sec = _best_window_rate(step, batch, n_steps=n_steps, windows=3)
     result = {}
     if include_model_key:
@@ -386,6 +493,10 @@ def _llama_train_bench(name, batch_size, seq_len, n_steps, prefix, include_model
     result[f"{prefix}_tokens_per_sec_per_chip"] = round(
         steps_per_sec * batch_size * seq_len / jax.device_count(), 1
     )
+    # per-chip optimizer-state residency: the ZeRO window's headline memory
+    # number (1/N under the sharded update, full under the replicated one)
+    result[f"{prefix}_opt_state_bytes_per_chip"] = state_bytes_per_chip(optimizer.opt_state)
+    result[f"{prefix}_steady_state_compile_count"] = compiles.compile_count - compiles_before_window
     peak = _chip_peak_flops()
     if peak is not None:
         flops = _train_flops_per_step(model.config, batch_size, seq_len)
@@ -1488,6 +1599,61 @@ def bench_analysis() -> dict:
     summarize("analysis_llama", report)
     result["analysis_llama_errors"] = [str(f) for f in report.errors]
 
+    # the before/after pair for the ZeRO contract diff: the same two programs
+    # audited with the legacy replicated update (zero_stage=0), so one
+    # trajectory entry carries BOTH sides of `_overlap_serialized_comm_bytes`
+    # and the drop is readable without digging up the pre-ZeRO round
+    for rep_prefix, builder in (
+        ("analysis_bert_replicated", "bert"),
+        ("analysis_llama_replicated", "llama"),
+    ):
+        _reset_state()
+        if builder == "bert":
+            rep_acc = Accelerator(
+                mixed_precision="bf16", parallelism=ParallelismConfig(zero_stage=0)
+            )
+            rep_model = Bert(bert_name)
+            rep_acc.prepare_model(rep_model)
+            rep_acc.prepare_optimizer(optax.adamw(2e-5))
+            rep_loss, rep_batch = Bert.loss_fn(rep_model), {
+                k: jax.device_put(np.asarray(v), rep_acc.state.data_sharding())
+                for k, v in batch.items()
+            }
+        else:
+            rep_acc = Accelerator(
+                mixed_precision="bf16",
+                parallelism=ParallelismConfig(
+                    data=1, fsdp=jax.device_count(), zero_stage=0
+                ),
+                fsdp_plugin=FullyShardedDataParallelPlugin(
+                    stage=3, activation_checkpointing=True
+                ),
+            )
+            rep_model = Llama(llama_name)
+            rep_acc.prepare_model(rep_model)
+            rep_acc.prepare_optimizer(optax.adamw(3e-4))
+
+            def rep_loss(params, b, _model=rep_model):
+                logits = _model.apply(params, b["input_ids"])[:, :-1].astype(jnp.float32)
+                tgt = b["input_ids"][:, 1:]
+                lse = jax.nn.logsumexp(logits, axis=-1)
+                tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+                return (lse - tgt_logit).mean()
+
+            rep_batch = {
+                "input_ids": jax.device_put(
+                    np.asarray(lbatch["input_ids"]), rep_acc.state.data_sharding()
+                )
+            }
+        rep_report = rep_acc.analyze(
+            rep_loss, rep_batch, label=f"{rep_prefix}_probe", write_record=False
+        )
+        rep_sched = rep_report.inventory.get("schedule", {})
+        result[f"{rep_prefix}_overlap_serialized_comm_bytes"] = rep_sched.get(
+            "serialized_comm_bytes"
+        )
+        result[f"{rep_prefix}_overlap_overlapped_count"] = rep_sched.get("overlapped_count")
+
     # the differential gate: both bench-scale reports against their
     # checked-in contracts. Drift count must be 0; on an environment that
     # differs from the recorded one (contracts pin backend + device count)
@@ -1564,6 +1730,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "analysis":
         print(json.dumps(bench_analysis()))
         return
+    if os.environ.get("BENCH_ONLY") == "zero":
+        print(json.dumps(bench_zero()))
+        return
     if os.environ.get("BENCH_ONLY") == "observability":
         print(json.dumps(bench_observability()))
         return
@@ -1596,6 +1765,7 @@ def main() -> None:
         ("bert", bench_bert_training, ("bert_train_steps_per_sec_per_chip",)),
         ("llama_fsdp", bench_llama_fsdp, ("llama_fsdp_train_mfu",)),
         ("llama_seq4096", bench_llama_longseq, ("llama_seq4096_train_mfu",)),
+        ("zero", bench_zero, ()),
         ("bigmodel", lambda: _bench_subprocess("bigmodel"), ("bigmodel_int8_ratio",)),
         # 1800s outer > 1400s inner + middle-process jax/TPU-client init and
         # ambient probe (~100-300s): the INNER timeout always fires first, so

@@ -1,0 +1,721 @@
+"""ZeRO-style sharded weight update (parallel/zero.py; ISSUE 12).
+
+The claims this file pins, each as a measured property rather than prose:
+
+- **Exactness** — the sharded update is the replicated update: identical
+  seeded gradients through both paths give bit-identical params + optimizer
+  state at float tolerance 0 over 10 steps (params + opt state gathered),
+  on both the bert-tiny DP layout and a mixed data×fsdp llama layout.
+- **Fidelity** — the fused ZeRO step's loss matches the plain (non-donated)
+  GSPMD forward, which is the value that matches the float64 reference; the
+  legacy donated FSDP program deviates from it on this backend.
+- **Resilience** — a chaos-injected NaN step under guards skips the update
+  bit-exactly and training continues (skip/restore semantics survive
+  sharding); the fp16 scaler backs off on a genuine overflow and skips.
+- **State** — checkpoint save→resume of the sharded optimizer state is
+  bit-exact, including resharding onto a different mesh layout.
+- **Caching** — the optimizer's update-program cache keys on the sharding
+  layout, so a re-prepared optimizer on a different layout can never reuse
+  a wrong-donation / wrong-shard program.
+- **The audit has teeth** — optimizer state resolving to replication under
+  declared ZeRO intent is an ERROR from the replication audit, and the
+  schedule pass's ready-window classification behaves as documented.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import (
+    Accelerator,
+    FullyShardedDataParallelPlugin,
+    ParallelismConfig,
+)
+from accelerate_tpu.models import Bert, Llama
+from accelerate_tpu.parallel.sharding import fold_update_spec, zero_batch_axes
+from accelerate_tpu.parallel.zero import zero_eligible, zero_update_state_bytes
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils.random import set_seed
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _bert_batch(model, n=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    acc_state = AcceleratorState()
+    sharding = acc_state.data_sharding()
+    return {
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, model.config.vocab_size, (n, seq)), jnp.int32),
+            sharding,
+        ),
+        "attention_mask": jax.device_put(jnp.ones((n, seq), jnp.int32), sharding),
+        "labels": jax.device_put(
+            jnp.asarray(rng.integers(0, 2, (n,)), jnp.int32), sharding
+        ),
+    }
+
+
+def _llama_loss(model):
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["input_ids"])[:, :-1].astype(jnp.float32)
+        tgt = batch["input_ids"][:, 1:]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (lse - tgt_logit).mean()
+
+    return loss_fn
+
+
+def _tree_equal(a, b) -> bool:
+    return all(jax.tree.leaves(jax.tree.map(np.array_equal, a, b)))
+
+
+# ---------------------------------------------------------------------------
+# enablement / spec engine
+# ---------------------------------------------------------------------------
+
+
+def test_zero_resolution_default_optout_and_demand():
+    _reset()
+    acc = Accelerator()
+    assert acc._zero_update_sharding  # auto-on for plain data parallel
+    _reset()
+    acc = Accelerator(parallelism=ParallelismConfig(zero_stage=0))
+    assert not acc._zero_update_sharding  # explicit legacy opt-out
+    _reset()
+    # model-parallel axes make the mesh ineligible: auto stays off...
+    acc = Accelerator(parallelism=ParallelismConfig(data=4, tensor=2))
+    assert not acc._zero_update_sharding
+    _reset()
+    # ...and demanding it fails loudly instead of silently degrading
+    with pytest.raises(ValueError, match="zero_stage"):
+        Accelerator(parallelism=ParallelismConfig(data=4, tensor=2, zero_stage=1))
+    _reset()
+    # legacy stage-1/2 FSDP keeps its explicit params-replicated contract
+    acc = Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin(stage=2))
+    assert not acc._zero_update_sharding
+
+
+def test_fold_update_spec_engine():
+    _reset()
+    mesh = AcceleratorState().mesh
+    axes = zero_batch_axes(mesh)
+    assert axes  # the 8-device test mesh has a data axis
+    # largest divisible free dim takes the fold
+    folded = fold_update_spec((64, 4), P(None, None), mesh, axes)
+    assert folded[0] == (axes[0] if len(axes) == 1 else tuple(axes))
+    assert folded[1] is None
+    # an already-sharded dim is extended, preserving the outer split
+    folded = fold_update_spec((64, 4), P("tensor", None), mesh, ("data",))
+    assert folded[0] == ("tensor", "data")
+    # nothing divisible: the spec survives untouched (replicated update leaf)
+    assert fold_update_spec((3,), P(None), mesh, ("data",)) == P(None)
+    # axes already present are never folded twice
+    assert fold_update_spec((64,), P("data"), mesh, ("data",)) == P("data")
+
+
+def test_zero_collective_layout_round_trip():
+    """device_put storage layout and the manual all_gather/psum_scatter pair
+    must agree on the axis linearization — including a tuple split over two
+    mesh axes (the data×fsdp fold)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+
+    _reset()
+    acc = Accelerator(parallelism=ParallelismConfig(data=2, fsdp=4))
+    mesh = acc.mesh
+    x = jnp.arange(64 * 3, dtype=jnp.float32).reshape(64, 3)
+    spec = P(("data", "fsdp"), None)
+    stored = jax.device_put(x, NamedSharding(mesh, spec))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=P(),
+        check_rep=False,
+    )
+    def gather(shard):
+        full = jax.lax.all_gather(shard, ("data", "fsdp"), axis=0, tiled=True)
+        return full
+
+    out = np.asarray(jax.jit(gather)(stored))
+    np.testing.assert_array_equal(out, np.asarray(x))
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=spec, check_rep=False
+    )
+    def scatter(full):
+        return jax.lax.psum_scatter(
+            full, ("data", "fsdp"), scatter_dimension=0, tiled=True
+        )
+
+    # full replicated input: scatter sums 8 identical copies → 8x shards,
+    # laid out exactly like the storage split
+    scattered = jax.jit(scatter)(jax.device_put(x, NamedSharding(mesh, P())))
+    np.testing.assert_array_equal(np.asarray(scattered), 8 * np.asarray(x))
+
+
+def test_sharded_global_norm_counts_partially_folded_leaves_once():
+    """A leaf whose dim divides by fsdp but not fsdp×data keeps only the
+    fsdp split — its elements are REPLICATED across data, and the norm's
+    uniform psum must not count them data-times (regression: gnorm inflation
+    would over-clip vs the replicated path)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+
+    from accelerate_tpu.parallel.zero import sharded_global_norm
+
+    _reset()
+    acc = Accelerator(parallelism=ParallelismConfig(data=2, fsdp=4))
+    mesh = acc.mesh
+    rng = np.random.default_rng(0)
+    full = {
+        "folded": jnp.asarray(rng.standard_normal((16, 4)), jnp.float32),
+        "partial": jnp.asarray(rng.standard_normal((12, 4)), jnp.float32),
+        "replicated": jnp.asarray(rng.standard_normal((3,)), jnp.float32),
+    }
+    specs = {
+        "folded": P(("data", "fsdp"), None),
+        "partial": P("fsdp", None),  # 12 % 8 != 0: the data axis didn't fold
+        "replicated": P(None),
+    }
+    stored = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in full.items()
+    }
+
+    @partial(shard_map, mesh=mesh, in_specs=(specs,), out_specs=P(), check_rep=False)
+    def norm(tree):
+        return sharded_global_norm(tree, specs, ("data", "fsdp"), mesh)
+
+    got = float(jax.jit(norm)(stored))
+    want = float(np.sqrt(sum(np.sum(np.square(np.asarray(v))) for v in full.values())))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_leaf_coupling_optimizers_are_rejected_under_zero():
+    """A transform that couples gradient leaves (clip_by_global_norm inside
+    the chain) would compute its reduction over the local 1/N shard — the
+    prepare-time probe must reject it with both fixes named, while plain
+    adam-family transforms pass."""
+    from accelerate_tpu.parallel.zero import tx_couples_across_leaves
+
+    _reset()
+    accelerator = Accelerator()
+    model = Bert("bert-tiny")
+    prepared = accelerator.prepare_model(model)
+    coupled = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-3))
+    assert tx_couples_across_leaves(coupled, prepared.params)
+    # within-leaf reductions (trust ratios, RMS clipping) are coupling too
+    assert tx_couples_across_leaves(optax.adafactor(1e-3), prepared.params)
+    assert tx_couples_across_leaves(optax.lamb(1e-3), prepared.params)
+    assert not tx_couples_across_leaves(optax.adamw(1e-3), prepared.params)
+    assert not tx_couples_across_leaves(optax.sgd(1e-2, momentum=0.9), prepared.params)
+    with pytest.raises(ValueError, match="clip_grad_norm_|zero_stage=0"):
+        accelerator.prepare_optimizer(coupled)
+    # the legacy path still accepts it
+    _reset()
+    accelerator = Accelerator(parallelism=ParallelismConfig(zero_stage=0))
+    accelerator.prepare_model(Bert("bert-tiny"))
+    accelerator.prepare_optimizer(coupled)
+
+
+def test_zero_update_state_bytes_formula():
+    opt_chip, grad_chip = zero_update_state_bytes(1000, 4, 8)
+    assert opt_chip == -(-1000 * 12 // 8)
+    assert grad_chip == 500
+    full_opt, full_grad = zero_update_state_bytes(1000, 4, 1)
+    assert (full_opt, full_grad) == (12000, 4000)
+
+
+# ---------------------------------------------------------------------------
+# the bit-exactness gate (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _updated_state(make_acc, model_ctor, n_steps=10, lr=3e-4):
+    """Feed IDENTICAL seeded gradients through the update path of the given
+    accelerator config; return (params, opt_state) gathered to host."""
+    _reset()
+    set_seed(0)
+    accelerator = make_acc()
+    model = model_ctor()
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(lr))
+    rng = np.random.default_rng(0)
+    host_params = jax.tree.map(np.asarray, prepared.params)
+    for _ in range(n_steps):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+            host_params,
+        )
+        optimizer.accumulate_grads(jax.device_put(grads, prepared.params_shardings))
+        optimizer.step()
+    return (
+        jax.tree.map(np.asarray, prepared.params),
+        jax.tree.map(np.asarray, optimizer.opt_state),
+    )
+
+
+def test_sharded_update_bit_equals_replicated_bert():
+    """10 steps of identical gradients: the ZeRO-sharded adamw (1/N state)
+    and the replicated adamw produce bit-identical params AND optimizer
+    state at tolerance 0 — the decomposition is exact, not approximate."""
+    p_z, o_z = _updated_state(lambda: Accelerator(), lambda: Bert("bert-tiny"))
+    p_r, o_r = _updated_state(
+        lambda: Accelerator(parallelism=ParallelismConfig(zero_stage=0)),
+        lambda: Bert("bert-tiny"),
+    )
+    assert _tree_equal(p_z, p_r)
+    assert _tree_equal(o_z, o_r)
+
+
+def test_sharded_update_bit_equals_replicated_llama_mixed_mesh():
+    """Same gate on a data×fsdp mesh with stage-3 FSDP: the fold extends the
+    fsdp split with the data axis (tuple specs), and the update must still
+    be bit-identical to the zero_stage=0 layout."""
+
+    def make(stage):
+        return lambda: Accelerator(
+            parallelism=ParallelismConfig(data=2, fsdp=4, zero_stage=stage),
+            fsdp_plugin=FullyShardedDataParallelPlugin(stage=3),
+        )
+
+    p_z, o_z = _updated_state(make(None), lambda: Llama("llama-tiny"))
+    p_r, o_r = _updated_state(make(0), lambda: Llama("llama-tiny"))
+    assert _tree_equal(p_z, p_r)
+    assert _tree_equal(o_z, o_r)
+
+
+def test_fused_zero_step_loss_matches_unpartitioned_forward():
+    """The fused ZeRO FSDP step computes the same loss as the plain
+    (non-donated, loss-only) GSPMD program — the value that agrees with the
+    float64 reference. The legacy donated fused program deviates from it on
+    this backend (~4e-3 relative), which is exactly why the manual program
+    carries this anchor."""
+    _reset()
+    set_seed(0)
+    accelerator = Accelerator(
+        parallelism=ParallelismConfig(data=1, fsdp=jax.device_count()),
+        fsdp_plugin=FullyShardedDataParallelPlugin(stage=3),
+    )
+    model = Llama("llama-tiny")
+    prepared = accelerator.prepare_model(model)
+    accelerator.prepare_optimizer(optax.adamw(3e-4))
+    loss_fn = _llama_loss(model)
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jax.device_put(
+            jnp.asarray(rng.integers(0, model.config.vocab_size, (8, 32)), jnp.int32),
+            accelerator.state.data_sharding(),
+        )
+    }
+    reference = float(jax.jit(loss_fn)(prepared.params, batch))
+    step = accelerator.compiled_step(loss_fn)
+    fused = float(step(batch))
+    np.testing.assert_allclose(fused, reference, rtol=1e-6)
+
+
+def test_fused_zero_step_tracks_eager_path():
+    """Fused ZeRO step vs the eager backward()/step() path over 5 steps on
+    bert-tiny: same semantics, different tracing (manual vs auto-partitioned
+    backward), so agreement is reassociation-level, not bitwise."""
+    def run(fused: bool):
+        _reset()
+        set_seed(0)
+        accelerator = Accelerator()
+        model = Bert("bert-tiny")
+        prepared = accelerator.prepare_model(model)
+        optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+        batch = _bert_batch(model)
+        loss_fn = Bert.loss_fn(model)
+        if fused:
+            step = accelerator.compiled_step(loss_fn)
+            losses = [float(step(batch)) for _ in range(5)]
+        else:
+            losses = []
+            for _ in range(5):
+                accelerator.gradient_state._set_sync_gradients(True)
+                losses.append(float(accelerator.backward(loss_fn, batch)))
+                optimizer.step()
+                optimizer.zero_grad()
+        return losses, jax.tree.map(np.asarray, prepared.params)
+
+    fused_losses, fused_params = run(True)
+    eager_losses, eager_params = run(False)
+    # the LOSS trajectory is the functional check: step k's loss is computed
+    # on k-times-updated params, so agreement here means the param
+    # trajectories are equivalent. Element-wise param comparison is NOT
+    # meaningful between differently-traced backwards: bert-tiny's grads on
+    # random labels sit at noise level, where adamw's m/sqrt(v) is
+    # sign-sensitive to last-bit gradient differences.
+    np.testing.assert_allclose(fused_losses, eager_losses, rtol=1e-4)
+
+
+def test_zero_microbatch_accumulation_matches_legacy():
+    """The in-program lax.scan over microbatches composes with the manual
+    region (params gathered ONCE outside the scan — the gather cost
+    amortizes over the window, unlike the replicated path's per-micro
+    all-reduce), and its loss trajectory matches the legacy replicated
+    program's."""
+
+    def run(stage):
+        _reset()
+        set_seed(0)
+        accelerator = Accelerator(
+            gradient_accumulation_steps=2,
+            parallelism=ParallelismConfig(zero_stage=stage),
+        )
+        model = Bert("bert-tiny")
+        accelerator.prepare_model(model)
+        accelerator.prepare_optimizer(optax.adamw(1e-3))
+        batch = _bert_batch(model, n=16)
+        step = accelerator.compiled_step(Bert.loss_fn(model))
+        return [float(step(batch)) for _ in range(4)]
+
+    zero_losses = run(None)
+    legacy_losses = run(0)
+    assert all(np.isfinite(zero_losses))
+    np.testing.assert_allclose(zero_losses, legacy_losses, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# resilience under sharding
+# ---------------------------------------------------------------------------
+
+
+def test_zero_guard_skip_survives_sharding():
+    """A chaos-injected NaN step under the ZeRO fused program must skip the
+    update bit-exactly: 5 guarded steps with NaN at step 2 end at EXACTLY
+    the params of a fault-free 4-step ZeRO run."""
+    from accelerate_tpu.resilience import FaultPlan, GuardPolicy, ResilienceConfig
+
+    def clean(n_steps):
+        _reset()
+        set_seed(0)
+        accelerator = Accelerator()
+        model = Bert("bert-tiny")
+        prepared = accelerator.prepare_model(model)
+        accelerator.prepare_optimizer(optax.adamw(1e-3))
+        step = accelerator.compiled_step(Bert.loss_fn(model))
+        batch = _bert_batch(model)
+        for _ in range(n_steps):
+            step(batch)
+        return jax.tree.map(np.asarray, prepared.params)
+
+    clean_params = clean(4)
+    _reset()
+    set_seed(0)
+    accelerator = Accelerator(
+        resilience_config=ResilienceConfig(
+            guard=GuardPolicy(check_every=100), fault_plan=FaultPlan(nan_steps=(2,))
+        )
+    )
+    assert accelerator._zero_update_sharding
+    model = Bert("bert-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    step = accelerator.compiled_step(Bert.loss_fn(model))
+    batch = _bert_batch(model)
+    losses = [float(step(batch)) for _ in range(5)]
+    guard = accelerator.resilience.guard
+    guard.check(prepared, optimizer)  # flush the window so the counter is live
+    assert guard.skipped_steps == 1
+    # chaos steps are 1-based: step 2 is the SECOND call; its skip means the
+    # third loss (computed on the un-updated params) repeats the second
+    assert losses[2] == losses[1]
+    assert _tree_equal(clean_params, jax.tree.map(np.asarray, prepared.params))
+
+
+def test_zero_fp16_scaler_semantics():
+    """GradScaler under the ZeRO fused program: finite steps update, an
+    injected-inf batch skips and backs off the scale, recovery resumes. The
+    manual backward keeps its fp16 region collective-free, so the scale
+    trajectory can sit HIGHER than the legacy GSPMD program's (whose fp16
+    cotangent all-reduce overflows spuriously) — asserted semantics only."""
+
+    class LinearModel:
+        def init(self, rng):
+            del rng
+            return {"a": jnp.zeros((), jnp.float32), "b": jnp.zeros((), jnp.float32)}
+
+        @staticmethod
+        def apply(params, x):
+            return params["a"] * x + params["b"]
+
+    def loss_fn(params, batch):
+        return jnp.mean((LinearModel.apply(params, batch["x"]) - batch["y"]) ** 2)
+
+    _reset()
+    accelerator = Accelerator(mixed_precision="fp16")
+    assert accelerator._zero_update_sharding
+    model, optimizer = accelerator.prepare(LinearModel(), optax.sgd(0.1))
+    step = accelerator.compiled_step(loss_fn)
+    sharding = accelerator.state.data_sharding()
+    batch = {
+        "x": jax.device_put(jnp.linspace(-1, 1, 8), sharding),
+        "y": jax.device_put(2 * jnp.linspace(-1, 1, 8) + 3, sharding),
+    }
+    for _ in range(3):
+        loss = float(step(batch))
+        assert np.isfinite(loss)
+    assert float(jax.device_get(model.params)["b"]) != 0.0
+    scale_before = float(optimizer.scale)
+    snapshot = jax.device_get(model.params)
+    bad = {
+        "x": jax.device_put(jnp.ones((8,)), sharding),
+        "y": jax.device_put(jnp.full((8,), np.inf, jnp.float32), sharding),
+    }
+    step(bad)
+    assert optimizer.step_was_skipped
+    after = jax.device_get(model.params)
+    np.testing.assert_array_equal(float(after["a"]), float(snapshot["a"]))
+    np.testing.assert_array_equal(float(after["b"]), float(snapshot["b"]))
+    assert float(optimizer.scale) < scale_before
+    step(batch)
+    assert not optimizer.step_was_skipped
+
+
+# ---------------------------------------------------------------------------
+# checkpointing: sharded state round-trip + resharding
+# ---------------------------------------------------------------------------
+
+
+def test_zero_checkpoint_roundtrip_bit_exact_and_reshards(tmp_path):
+    """save_state → load_state of the ZeRO-sharded optimizer state is
+    bit-exact across resume (same config), and loads correctly into a
+    DIFFERENT mesh layout (replica-count change: data=8 → data=2×fsdp=4)."""
+
+    def build(parallelism=None):
+        _reset()
+        set_seed(0)
+        accelerator = Accelerator(parallelism=parallelism)
+        model = Bert("bert-tiny")
+        prepared = accelerator.prepare_model(model)
+        optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+        step = accelerator.compiled_step(Bert.loss_fn(model))
+        batch = _bert_batch(model)
+        return accelerator, prepared, optimizer, step, batch
+
+    # continuous 5-step reference
+    _, prepared, optimizer, step, batch = build()
+    for _ in range(5):
+        step(batch)
+    reference_params = jax.tree.map(np.asarray, prepared.params)
+    reference_opt = jax.tree.map(np.asarray, optimizer.opt_state)
+
+    # 3 steps → save → fresh accelerator → load → 2 more steps
+    accelerator, prepared, optimizer, step, batch = build()
+    for _ in range(3):
+        step(batch)
+    accelerator.save_state(str(tmp_path / "ckpt"))
+
+    accelerator, prepared, optimizer, step, batch = build()
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    for _ in range(2):
+        step(batch)
+    assert _tree_equal(reference_params, jax.tree.map(np.asarray, prepared.params))
+    assert _tree_equal(reference_opt, jax.tree.map(np.asarray, optimizer.opt_state))
+
+    # resharding: the same checkpoint restores onto a 2x4 mesh, where the
+    # fold produces tuple splits — gathered values must match the saved ones
+    accelerator, prepared, optimizer, step, batch = build(
+        parallelism=ParallelismConfig(data=2, fsdp=4)
+    )
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    # the 3-step state we saved, gathered from the new layout
+    _, prepared3, optimizer3, step3, batch3 = build()
+    for _ in range(3):
+        step3(batch3)
+    assert _tree_equal(
+        jax.tree.map(np.asarray, prepared3.params),
+        jax.tree.map(np.asarray, prepared.params),
+    )
+    assert _tree_equal(
+        jax.tree.map(np.asarray, optimizer3.opt_state),
+        jax.tree.map(np.asarray, optimizer.opt_state),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the update-program cache keys on the sharding layout (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_update_program_cache_keyed_by_sharding_spec():
+    """An optimizer whose state layout changes (re-prepared model / ZeRO
+    layout swapped in) must trace a FRESH update program: reusing the old
+    one would run with wrong donation aliases and wrong shard shapes. The
+    clip settings stay part of the key alongside (regression for the
+    original clip-keyed invalidation)."""
+    _reset()
+    acc = Accelerator()
+    model = Bert("bert-tiny")
+    prepared = acc.prepare_model(model)
+    optimizer = acc.prepare_optimizer(optax.adamw(1e-3))
+    rng = np.random.default_rng(0)
+    grads = jax.device_put(
+        jax.tree.map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+            jax.tree.map(np.asarray, prepared.params),
+        ),
+        prepared.params_shardings,
+    )
+    optimizer.accumulate_grads(grads)
+    optimizer.step()
+    assert len(optimizer._update_fns) == 1
+    key_zero = next(iter(optimizer._update_fns))
+
+    # clip change → new entry, old retained (flipping back is a cache hit)
+    optimizer.set_clip_grad_norm(1.0)
+    optimizer.accumulate_grads(grads)
+    optimizer.step()
+    assert len(optimizer._update_fns) == 2
+
+    # layout change → new entry even at identical clip settings
+    optimizer.set_clip_grad_norm(None)
+    from accelerate_tpu.parallel.sharding import replicated
+
+    rep = replicated(acc.mesh)
+    optimizer._params_shardings = jax.tree.map(lambda _: rep, prepared.params_shardings)
+    optimizer._opt_state_shardings = jax.tree.map(
+        lambda _: rep, optimizer._opt_state_shardings
+    )
+    optimizer._opt_state_device_shardings = optimizer._opt_state_shardings
+    optimizer.opt_state = jax.device_put(optimizer.opt_state, optimizer._opt_state_shardings)
+    prepared.box.value = jax.device_put(prepared.box.value, jax.tree.map(lambda _: rep, prepared.params_shardings))
+    optimizer.accumulate_grads(jax.device_put(grads, jax.tree.map(lambda _: rep, prepared.params_shardings)))
+    optimizer.step()
+    assert len(optimizer._update_fns) == 3
+    assert optimizer._update_key() != key_zero
+    # and the sharded program is the audited one: donation held on it
+    report = optimizer.verify_donation()
+    assert report.errors == [], report.render()
+
+
+# ---------------------------------------------------------------------------
+# the audit has teeth (acceptance: replication ERROR under declared intent)
+# ---------------------------------------------------------------------------
+
+
+def test_replicated_opt_state_under_zero_intent_is_an_error():
+    """Seeded regression: the canonical bert program with its state forced
+    back to full replication (the exact shape of "the update silently
+    stopped sharding") must FAIL the replication audit with
+    REPLICATED_PARAM errors — under declared ZeRO intent the audit asserts
+    sharding, it does not inventory it."""
+    from accelerate_tpu.parallel.sharding import replicated
+
+    _reset()
+    accelerator = Accelerator()
+    assert accelerator._sharding_intent()  # ZeRO declares intent
+    model = Bert("bert-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    rep = replicated(accelerator.mesh)
+    prepared.params_shardings = jax.tree.map(lambda _: rep, prepared.params_shardings)
+    prepared.box.value = jax.device_put(prepared.box.value, prepared.params_shardings)
+    optimizer._params_shardings = prepared.params_shardings
+    optimizer._opt_state_shardings = jax.tree.map(
+        lambda _: rep, optimizer._opt_state_shardings
+    )
+    optimizer._opt_state_device_shardings = optimizer._opt_state_shardings
+    optimizer.opt_state = jax.device_put(optimizer.opt_state, optimizer._opt_state_shardings)
+    step = accelerator.compiled_step(Bert.loss_fn(model))
+    batch = _bert_batch(model)
+    report = accelerator.analyze(
+        step=step,
+        batch=batch,
+        label="bert_tiny_step_seeded_replicated_opt",
+        write_record=False,
+        replication_threshold_bytes=1 << 14,
+    )
+    replicated_errors = [f for f in report.errors if f.code == "REPLICATED_PARAM"]
+    assert replicated_errors, report.render()
+    # both the moments and the parameters are named, so the author is
+    # pointed at the state that lost its sharding
+    flagged = " ".join(f.path for f in replicated_errors)
+    assert "opt_state" in flagged or "mu" in flagged or "nu" in flagged, flagged
+
+
+def test_schedule_ready_window_classification():
+    """The sync-collective ready-window walk: a gather over program inputs
+    whose consumer sits past independent compute is overlapped; a collective
+    produced late and consumed immediately is serialized; an unscheduled
+    module never credits sync overlap."""
+    from accelerate_tpu.analysis.schedule import collective_schedule
+
+    hlo = """
+HloModule m, is_scheduled=true
+
+ENTRY %main {
+  %p0 = f32[16,16] parameter(0)
+  %p1 = f32[128,16] parameter(1)
+  %ag = f32[128,16] all-gather(f32[16,16] %p0), dimensions={0}
+  %mm1 = f32[128,16] multiply(f32[128,16] %p1, f32[128,16] %p1)
+  %mm2 = f32[128,16] add(f32[128,16] %mm1, f32[128,16] %p1)
+  %use = f32[128,16] add(f32[128,16] %ag, f32[128,16] %mm2)
+  %rs = f32[16,16] reduce-scatter(f32[128,16] %use), dimensions={0}
+  ROOT %out = f32[16,16] negate(f32[16,16] %rs)
+}
+"""
+    summary = collective_schedule(hlo)
+    by_kind = {op["kind"]: op for op in summary["collectives"]}
+    # the gather: ready at t=0 (parameter input), consumer after 2 compute
+    assert by_kind["all_gather"]["overlapped"]
+    assert by_kind["all_gather"]["overlap_compute_ops"] == 2
+    # the scatter: produced by its own last dep (%use) right before, consumed
+    # immediately by the ROOT — empty ready-window, serialized
+    assert not by_kind["reduce_scatter"]["overlapped"]
+    assert summary["sync_overlapped_count"] == 1
+    assert summary["overlapped_count"] == 1
+
+    unscheduled = collective_schedule(hlo.replace(", is_scheduled=true", ""))
+    assert unscheduled["overlapped_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_state_bytes_per_chip_reports_shard_residency():
+    from accelerate_tpu.telemetry.memory import state_bytes_per_chip
+
+    _reset()
+    acc = Accelerator()
+    mesh = acc.mesh
+    full = jnp.zeros((64, 8), jnp.float32)
+    replicated_tree = {"m": jax.device_put(full, NamedSharding(mesh, P()))}
+    sharded_tree = {"m": jax.device_put(full, NamedSharding(mesh, P("data")))}
+    assert state_bytes_per_chip(replicated_tree) == full.nbytes
+    assert state_bytes_per_chip(sharded_tree) == full.nbytes // 8
+
+
+def test_estimate_memory_zero_column(capsys):
+    from accelerate_tpu.commands.cli import main
+
+    rc = main(["estimate-memory", "llama-tiny", "--replicas", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "+adam/chip @8 (ZeRO)" in out
+    assert "sharded 1/8 per chip" in out
+    # and the column prices below the replicated train budget
+    rc = main(["estimate-memory", "params=1000000", "--replicas", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "+adam/chip @8 (ZeRO)" in out
