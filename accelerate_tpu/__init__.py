@@ -30,7 +30,15 @@ from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .analysis import AnalysisReport, HazardSanitizer
-from .resilience import FaultPlan, GuardPolicy, ResilienceConfig, RetryPolicy
+from .resilience import (
+    ElasticConfig,
+    ElasticCoordinator,
+    ElasticFailure,
+    FaultPlan,
+    GuardPolicy,
+    ResilienceConfig,
+    RetryPolicy,
+)
 from .telemetry import Telemetry, TelemetryConfig
 from .parallel.local_sgd import LocalSGD
 from .scheduler import AcceleratedScheduler

@@ -233,19 +233,42 @@ class Accelerator:
         # once. zero_stage=None auto-enables on eligible meshes (data-parallel
         # axes present, model axes trivial); 0 forces the legacy replicated
         # update; >=1 demands sharding and fails loudly on an ineligible mesh.
-        from .parallel.zero import zero_eligible
+        from .parallel.zero import zero_ineligible_reason
 
         requested = getattr(self.state.parallelism, "zero_stage", None)
-        eligible = zero_eligible(self.mesh, fsdp_plugin)
+        ineligible_reason = zero_ineligible_reason(self.mesh, fsdp_plugin)
+        eligible = ineligible_reason is None
         if requested is not None and requested >= 1 and not eligible:
             raise ValueError(
                 f"zero_stage={requested} requested but the update cannot be "
-                "sharded on this configuration (needs a nontrivial data/fsdp "
-                "axis, no tensor/sequence/pipeline/expert axes, and no "
-                "stage<3 or cpu_offload FSDP plugin). Drop zero_stage or fix "
-                "the mesh."
+                f"sharded on this configuration: {ineligible_reason}. Drop "
+                "zero_stage or fix the mesh."
             )
         self._zero_update_sharding = eligible and requested != 0
+        # cpu_offload used to fall back to the legacy replicated path
+        # SILENTLY (ROADMAP item): the mesh is ZeRO-eligible, the user asked
+        # for nothing unusual, and the run quietly pays N× the optimizer
+        # state. Name the fallback where someone will look — the stage<3
+        # case stays quiet because that replicated-params contract is the
+        # explicit, documented meaning of the flag.
+        self._zero_fallback_reason = None
+        if (
+            requested != 0
+            and not eligible
+            and fsdp_plugin is not None
+            and fsdp_plugin.cpu_offload
+            and fsdp_plugin.stage >= 3
+            and zero_ineligible_reason(self.mesh, None) is None
+        ):
+            self._zero_fallback_reason = ineligible_reason
+            logger.warning(
+                "ZeRO sharded update DISABLED — falling back to the legacy "
+                f"replicated update: {ineligible_reason}. Optimizer state "
+                "will be replicated on every chip (cpu_offload still moves "
+                "it to host RAM between steps); drop cpu_offload to get the "
+                "1/N sharded state, or pass ParallelismConfig(zero_stage=0) "
+                "to silence this."
+            )
         self.model_parallel_plugin = model_parallel_plugin
         self.compilation_config = compilation_config or CompilationConfig()
         if (
@@ -311,6 +334,16 @@ class Accelerator:
         # until the user calls telemetry.step()/flush().
         self.telemetry = Telemetry(accelerator=self, config=telemetry_config)
         self._profile_active = False
+        if self._zero_fallback_reason is not None and self.telemetry.enabled:
+            # the warning above is for the console; the record is for the
+            # telemetry stream (a fleet of silent fallbacks is a query away)
+            self.telemetry.write_record(
+                "zero",
+                {
+                    "event": "fallback_replicated",
+                    "reason": self._zero_fallback_reason,
+                },
+            )
         # -- resilience hub (resilience/hub.py): numerical guards fused into
         # compiled_step, the chaos fault-injection harness, and retry
         # observability. Inert (and compiled programs unchanged) unless a
@@ -1472,6 +1505,16 @@ class Accelerator:
         from .fault_tolerance import CheckpointManager
 
         return CheckpointManager(self, checkpoint_dir=checkpoint_dir, **manager_kwargs)
+
+    def elastic_coordinator(self, loss_fn: Callable, model: Optional[PreparedModel] = None, **kwargs):
+        """A ``resilience.elastic.ElasticCoordinator`` driving this
+        accelerator's compiled step with in-memory host-loss recovery:
+        buddy-redundant ZeRO shards, live mesh shrink/regrow, and the
+        chaos-drilled degradation ladder (buddy reshard → checkpoint reload
+        → fail loudly). See docs/resilience.md § Elastic training."""
+        from .resilience.elastic import ElasticCoordinator
+
+        return ElasticCoordinator(self, loss_fn, model=model, **kwargs)
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)

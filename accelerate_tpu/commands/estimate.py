@@ -52,6 +52,14 @@ def register_subcommand(subparsers):
         "gradient bytes PER CHIP when the update is sharded (the default "
         "training path on a multi-chip mesh)",
     )
+    parser.add_argument(
+        "--elastic-redundancy", type=int, default=0, choices=(0, 1), metavar="N",
+        help="Buddy copies per ZeRO shard for elastic training "
+        "(resilience/elastic.py): adds a per-chip column pricing the mirror "
+        "(params + optimizer state, 1/replicas each) that lets a host loss "
+        "recover in-memory instead of from checkpoint. 0 or 1 — the runtime "
+        "supports a single buddy roll (ElasticConfig rejects more)",
+    )
     parser.set_defaults(func=run)
     return parser
 
@@ -239,12 +247,17 @@ def run(args) -> int:
     # gradient per chip, so the train budget that used to be 4 bytes/param of
     # state per chip becomes 12/N + params — visible here BEFORE anyone runs a
     # step, same as the KV column prices serving.
-    from ..parallel.zero import zero_update_state_bytes
+    from ..parallel.zero import elastic_redundancy_bytes, zero_update_state_bytes
 
     replicas = max(int(getattr(args, "replicas", 1) or 1), 1)
+    redundancy = max(int(getattr(args, "elastic_redundancy", 0) or 0), 0)
+    show_elastic = replicas > 1 and redundancy > 0
     zero_col = f" | {f'+adam/chip @{replicas} (ZeRO)':>22}" if replicas > 1 else ""
+    # the buddy-mirror column sits NEXT TO the ZeRO column it duplicates:
+    # elastic redundancy is priced as extra bytes on top of the sharded state
+    elastic_col = f" | {f'+buddy/chip x{redundancy}':>16}" if show_elastic else ""
     kv_col = f" | {'+kv (serve)':>12}" if kv_fn is not None else ""
-    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}{zero_col}{kv_col}"
+    header = f"{'dtype':>10} | {'params':>10} | {'+grads':>10} | {'+adam (train)':>14}{zero_col}{elastic_col}{kv_col}"
     print(header)
     print("-" * len(header))
     for dtype in args.dtypes:
@@ -258,6 +271,8 @@ def run(args) -> int:
             # params are stored sharded too under ZeRO, but the forward
             # gathers them, so the per-chip working set still prices them full
             row += f" | {_convert_bytes(params + grad_chip + opt_chip):>22}"
+        if show_elastic:
+            row += f" | {_convert_bytes(elastic_redundancy_bytes(n, b, replicas, redundancy)):>16}"
         if kv_fn is not None:
             serve = params + kv_fn(4 if dtype == "float32" else 2)
             row += f" | {_convert_bytes(serve):>12}"
@@ -267,5 +282,18 @@ def run(args) -> int:
             f"ZeRO column: optimizer state (12 B/param fp32) and gradients "
             f"sharded 1/{replicas} per chip; reduce-scatter -> sharded adamw "
             f"-> all-gather (docs/performance.md)"
+        )
+    if show_elastic:
+        print(
+            f"Buddy column: {redundancy} mirror(s) of each chip's 1/{replicas} "
+            f"param + optimizer shard on a different host — a host loss "
+            f"recovers in-memory via the elastic ladder (docs/resilience.md)"
+        )
+    elif redundancy > 0:
+        # asked-for but unpriceable: say so instead of dropping the column
+        print(
+            "Elastic redundancy: needs --replicas N > 1 (the buddy mirrors "
+            "1/N ZeRO shards; with one replica there is nothing sharded to "
+            "mirror) — column skipped"
         )
     return 0

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import re
 import shlex
+import signal as signal_mod
 import subprocess
 import sys
 import threading
@@ -75,6 +76,13 @@ def register_subcommand(subparsers):
         help="Declare a worker dead when it prints nothing for this long "
         "(0 = disabled; needs --num_workers). Training loops that log "
         "per-step keep this armed cheaply.",
+    )
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="Partial-failure mode: when ONE worker dies or goes silent, "
+        "signal the survivors (SIGUSR1) to run an elastic mesh shrink "
+        "(resilience/elastic.py) and keep supervising the smaller fleet, "
+        "instead of killing and relaunching everything. Needs --num_workers.",
     )
     parser.add_argument(
         "--auto_resume", action="store_true",
@@ -165,6 +173,17 @@ class _Worker:
         if self.proc.poll() is None:
             self.proc.kill()
 
+    def notify(self, signum) -> bool:
+        """Deliver the elastic partial-failure signal to a live worker
+        (ignored if it already exited or the transport can't signal)."""
+        try:
+            if self.proc.poll() is None and hasattr(self.proc, "send_signal"):
+                self.proc.send_signal(signum)
+                return True
+        except OSError:
+            pass
+        return False
+
 
 def supervise(
     spawn,
@@ -173,6 +192,8 @@ def supervise(
     heartbeat_timeout: float = 0.0,
     poll_interval: float = 1.0,
     restart_policy: Optional[RetryPolicy] = None,
+    partial_failure: str = "relaunch",
+    elastic_signal=signal_mod.SIGUSR1,
 ) -> int:
     """Run ``spawn(i) -> Popen`` for every worker and monitor the fleet.
 
@@ -182,6 +203,16 @@ def supervise(
     host) and, with ``restarts`` left, the whole fleet relaunches. Per-worker
     exit codes are reported; the job's exit code is the first failing
     worker's (124 for a heartbeat kill).
+
+    ``partial_failure="elastic"`` (``pod-launch --elastic``) changes the
+    single-worker-death response: instead of killing the fleet, the failed
+    worker is removed (killed if merely heartbeat-silent), the SURVIVORS are
+    signalled with ``elastic_signal`` (SIGUSR1 — the training script's
+    :class:`~...resilience.elastic.ElasticCoordinator` turns it into a mesh
+    shrink at the next step boundary), and supervision continues over the
+    shrunken fleet. The job succeeds when every remaining worker exits 0;
+    only the LAST worker's failure falls through to the kill-and-relaunch
+    ladder. Losing a host then costs a reshard, not a fleet restart.
 
     ``spawn`` may accept a second ``attempt`` argument (1-based): relaunch
     attempts then get a different command — the auto-resume path appends
@@ -196,6 +227,10 @@ def supervise(
 
     if restart_policy is None:
         restart_policy = RESTART_POLICY
+    if partial_failure not in ("relaunch", "elastic"):
+        raise ValueError(
+            f"partial_failure must be 'relaunch' or 'elastic', got {partial_failure!r}"
+        )
 
     try:
         spawn_takes_attempt = len(inspect.signature(spawn).parameters) >= 2
@@ -223,6 +258,29 @@ def supervise(
                     if code is None and now - w.last_activity > heartbeat_timeout:
                         failed = (w.index, 124, f"silent for {heartbeat_timeout:.0f}s")
                         break
+            if failed is not None and partial_failure == "elastic" and len(workers) > 1:
+                # elastic shrink: drop the dead worker, signal the survivors
+                # to reshard, keep supervising the smaller fleet
+                dead = next(w for w in workers if w.index == failed[0])
+                dead.kill()  # a heartbeat-silent process is operationally dead
+                workers = [w for w in workers if w is not dead]
+                notified = sum(1 for w in workers if w.notify(elastic_signal))
+                # the survivors now pause to reassemble + recompile, printing
+                # nothing — restart their heartbeat clocks so the reshard gets
+                # one full window instead of being killed as "silent" mid-
+                # recovery (which would cascade one host loss into a fleet
+                # relaunch). Size --heartbeat_timeout above the expected
+                # reshard recompile time.
+                now = time.monotonic()
+                for w in workers:
+                    w.last_activity = now
+                print(
+                    f"pod-launch: worker {failed[0]} failed ({failed[2]}); "
+                    f"elastic mode — signalled {notified}/{len(workers)} "
+                    "survivors to shrink instead of relaunching the fleet",
+                    file=sys.stderr,
+                )
+                failed = None
             if failed is None:
                 time.sleep(poll_interval)
         for w in workers:
@@ -249,12 +307,13 @@ def supervise(
 
 def run(args) -> int:
     auto_resume = getattr(args, "auto_resume", False)
+    elastic = getattr(args, "elastic", False)
     command = assemble_worker_command(args)
     if args.num_workers is None:
-        if args.restart_on_failure or args.heartbeat_timeout or auto_resume:
+        if args.restart_on_failure or args.heartbeat_timeout or auto_resume or elastic:
             raise ValueError(
-                "--restart_on_failure/--heartbeat_timeout/--auto_resume need "
-                "--num_workers (supervision runs one ssh per worker)"
+                "--restart_on_failure/--heartbeat_timeout/--auto_resume/--elastic "
+                "need --num_workers (supervision runs one ssh per worker)"
             )
         cmd = build_gcloud_ssh_cmd(
             args.tpu_name, args.tpu_zone, command, worker=args.worker, use_alpha=args.use_alpha
@@ -303,4 +362,5 @@ def run(args) -> int:
         spawn, args.num_workers,
         restarts=args.restart_on_failure,
         heartbeat_timeout=args.heartbeat_timeout,
+        partial_failure="elastic" if elastic else "relaunch",
     )

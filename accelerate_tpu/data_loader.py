@@ -370,6 +370,23 @@ class BaseDataLoader(DataLoaderStateMixin):
 
         return recursively_apply(_make, local_batch)
 
+    def _remesh_stale(self, host_batch, global_batch):
+        """Elastic-training guard (resilience/elastic.py): a batch the
+        prefetch thread globalized BEFORE a mesh shrink/regrow is laid out
+        for the dead mesh — stepping it would resurrect lost devices.
+        Re-globalize from the retained host copy when the batch's mesh is no
+        longer the live one; the steady-state cost is one mesh identity
+        compare per batch."""
+        if not self.device_placement:
+            return global_batch
+        for leaf in jax.tree_util.tree_leaves(global_batch):
+            if isinstance(leaf, jax.Array):
+                mesh = getattr(leaf.sharding, "mesh", None)
+                if mesh is not None and mesh != self.state.mesh:
+                    return self._globalize(host_batch)
+                break
+        return global_batch
+
     def _mark_last_batch(self) -> None:
         self.end_of_dataloader = True
         if getattr(self, "_total_samples", None) is not None:
@@ -418,13 +435,22 @@ class BaseDataLoader(DataLoaderStateMixin):
             try:
                 current = None
                 have_current = False
+                # each item keeps its HOST batch alongside the globalized one:
+                # an elastic mesh shrink between produce and consume leaves
+                # the device copy on a dead mesh, and the consumer re-shards
+                # from the host copy (_remesh_stale). This pins up to
+                # `prefetch` host batch copies until consume (previously only
+                # the producer's in-flight pair was live) — the host-RAM
+                # price of elastic re-sharding; lower `prefetch` if it bites.
                 for nxt in batches:
-                    if have_current and not _put(("batch", self._globalize(current), False)):
+                    if have_current and not _put(
+                        ("batch", (current, self._globalize(current)), False)
+                    ):
                         return
                     current = nxt
                     have_current = True
                 if have_current:
-                    if not _put(("batch", self._globalize(current), True)):
+                    if not _put(("batch", (current, self._globalize(current)), True)):
                         return
             except BaseException as exc:  # surface dataset/collate errors in the consumer
                 _put(("error", exc, False))
@@ -444,7 +470,7 @@ class BaseDataLoader(DataLoaderStateMixin):
                 if is_last:
                     self._mark_last_batch()
                 self.batches_yielded += 1
-                yield payload
+                yield self._remesh_stale(*payload)
                 if is_last:
                     break
         finally:

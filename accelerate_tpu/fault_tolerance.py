@@ -279,6 +279,19 @@ def latest_valid_checkpoint(base: str, check_checksums: bool = True) -> Optional
     return None
 
 
+def checkpoint_step(directory: str, manifest: Optional[dict] = None) -> int:
+    """The training step a checkpoint was saved at, from its manifest (the
+    ``metadata.step`` CheckpointManager records, falling back to the manifest
+    root's step, then 0). Shared by ``CheckpointManager.resume`` and the
+    elastic checkpoint rung (resilience/elastic.py) so both agree on how many
+    steps a disk restore loses. Pass an already-read ``manifest`` to skip the
+    re-read."""
+    if manifest is None:
+        manifest = read_manifest(directory) or {}
+    meta = manifest.get("metadata", {})
+    return int(meta.get("step", manifest.get("step") or 0))
+
+
 @dataclass
 class ResumePoint:
     """What ``CheckpointManager.resume`` restored: the checkpoint path plus
@@ -573,7 +586,7 @@ class CheckpointManager:
         meta = manifest.get("metadata", {})
         point = ResumePoint(
             path=path,
-            step=int(meta.get("step", manifest.get("step") or 0)),
+            step=checkpoint_step(path, manifest),
             epoch=int(meta.get("epoch", 0)),
             dataloaders=meta.get("dataloaders", []),
             metadata=meta,

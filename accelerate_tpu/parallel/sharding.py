@@ -172,6 +172,13 @@ def shard_tree(tree: Any, shardings: Any) -> Any:
     return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
 
 
+def abstract_like(tree: Any) -> Any:
+    """Tree of arrays → tree of ShapeDtypeStructs: re-derive shardings for a
+    NEW mesh (elastic shrink/regrow) without touching the live buffers —
+    ``infer_shardings``/``shardings_like`` accept either."""
+    return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

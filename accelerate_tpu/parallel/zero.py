@@ -59,19 +59,41 @@ from .sharding import zero_batch_axes
 _MODEL_AXES = (MESH_AXIS_TENSOR, MESH_AXIS_SEQUENCE, MESH_AXIS_PIPELINE, MESH_AXIS_EXPERT)
 
 
+def zero_ineligible_reason(mesh: Mesh, fsdp_plugin=None) -> Optional[str]:
+    """Why the ZeRO sharded update cannot replace the replicated one on this
+    configuration (None = eligible): it needs at least one nontrivial
+    data-parallel axis, no model-parallel axes (their collectives live
+    inside the auto-partitioned forward), and no legacy stage-1/2 FSDP or
+    cpu-offload configuration (those keep params replicated / state in host
+    RAM by explicit contract). The reason string is what the fallback
+    warning and telemetry record name, so a run silently training on the
+    legacy path is a grep away."""
+    if not zero_batch_axes(mesh):
+        return "no nontrivial data/fsdp mesh axis to shard the update over"
+    model = [a for a in _MODEL_AXES if mesh.shape.get(a, 1) > 1]
+    if model:
+        return (
+            f"model-parallel axes {model} are nontrivial (their collectives "
+            "live inside the auto-partitioned forward)"
+        )
+    if fsdp_plugin is not None and fsdp_plugin.stage < 3:
+        return (
+            f"FullyShardedDataParallelPlugin(stage={fsdp_plugin.stage}) keeps "
+            "parameters replicated by explicit contract"
+        )
+    if fsdp_plugin is not None and fsdp_plugin.cpu_offload:
+        return (
+            "cpu_offload keeps optimizer state in host RAM, which the fused "
+            "sharded-update program does not support yet (ROADMAP: ZeRO "
+            "cpu_offload composition)"
+        )
+    return None
+
+
 def zero_eligible(mesh: Mesh, fsdp_plugin=None) -> bool:
     """Whether the ZeRO sharded update can replace the replicated one on this
-    mesh: at least one nontrivial data-parallel axis, no model-parallel axes
-    (their collectives live inside the auto-partitioned forward), and no
-    legacy stage-1/2 FSDP or cpu-offload configuration (those keep params
-    replicated / state in host RAM by explicit contract)."""
-    if not zero_batch_axes(mesh):
-        return False
-    if any(mesh.shape.get(a, 1) > 1 for a in _MODEL_AXES):
-        return False
-    if fsdp_plugin is not None and (fsdp_plugin.stage < 3 or fsdp_plugin.cpu_offload):
-        return False
-    return True
+    mesh (see :func:`zero_ineligible_reason` for the criteria)."""
+    return zero_ineligible_reason(mesh, fsdp_plugin) is None
 
 
 def tx_couples_across_leaves(tx, params_tree: Any) -> bool:
@@ -433,3 +455,18 @@ def zero_update_state_bytes(
     opt_full = n_params * 4 * 3
     grad_full = int(n_params * grad_dtype_bytes)
     return -(-opt_full // replicas), -(-grad_full // replicas)
+
+
+def elastic_redundancy_bytes(
+    n_params: int, param_dtype_bytes: float, replicas: int, redundancy: int = 1
+) -> int:
+    """Per-chip bytes of the elastic buddy mirror (resilience/elastic.py):
+    ``redundancy`` extra copies of the chip's 1/N parameter shard plus its
+    1/N optimizer-state shard, parked on a buddy rank so a host loss never
+    destroys a shard's only copy. Gradients are recomputed after recovery
+    and are not mirrored. The `estimate-memory --elastic-redundancy` column
+    prices this next to the ZeRO column."""
+    replicas = max(int(replicas), 1)
+    opt_chip, _ = zero_update_state_bytes(n_params, param_dtype_bytes, replicas)
+    param_chip = -(-int(n_params * param_dtype_bytes) // replicas)
+    return max(int(redundancy), 0) * (param_chip + opt_chip)

@@ -14,13 +14,17 @@ serving path. Four legs (see docs/resilience.md):
   ``retry_after``, slot quarantine (lives in ``serving/``, driven from here);
 - :mod:`~.retry` — one jittered-exponential-backoff :class:`RetryPolicy`
   consumed by checkpointing, the streamed big-model load path, the data
-  loader, and pod-launch relaunches.
+  loader, and pod-launch relaunches;
+- :mod:`~.elastic` — in-memory host-loss recovery for training: buddy-
+  redundant ZeRO shards, live mesh shrink/regrow, and a chaos-drilled
+  degradation ladder (buddy reshard → checkpoint reload → fail loudly).
 
 Everything reports through the Telemetry hub as ``{"kind": "resilience"}``
 records in ``telemetry.jsonl``.
 """
 
 from .chaos import FaultPlan
+from .elastic import ElasticConfig, ElasticCoordinator, ElasticFailure
 from .guards import GuardPolicy, NumericalGuard, tree_all_finite, zero_guard_state
 from .hub import Resilience, ResilienceConfig
 from .retry import (
@@ -36,6 +40,9 @@ __all__ = [
     "DEFAULT_IO_RETRY",
     "FLEET_RETRY",
     "HANDOFF_RETRY",
+    "ElasticConfig",
+    "ElasticCoordinator",
+    "ElasticFailure",
     "FaultPlan",
     "is_fleet_transient",
     "is_handoff_transient",

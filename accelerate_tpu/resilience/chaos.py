@@ -31,6 +31,13 @@ Fault legs:
 - ``heartbeat_loss_step`` / ``heartbeat_loss_index`` — the chosen replica's
   heartbeat probe goes permanently silent: the process may be alive, but an
   unreachable replica is operationally dead and the router must fail over;
+- ``host_loss_step`` / ``host_loss_index`` — the elastic-training drill
+  (resilience/elastic.py): at the chosen *training* step boundary (1-based,
+  like ``nan_steps``), host ``index``'s entire device group is declared dead
+  — every buffer on those devices is unreadable from that instant, and the
+  :class:`~.elastic.ElasticCoordinator` must recover through its degradation
+  ladder (buddy reshard → checkpoint reload → fail loudly) before the step
+  runs. Fires at most once;
 - ``handoff_stall_at`` / ``handoff_loss_at`` — disaggregated-serving drills
   over the router's live-KV handoff *attempts* (0-based attempt indices,
   fleet-wide): a stalled attempt sleeps ``stall_seconds`` mid-transfer (slow
@@ -93,6 +100,10 @@ class FaultPlan:
     replica_stall_index: int = 0
     heartbeat_loss_step: Optional[int] = None
     heartbeat_loss_index: int = 0
+    # elastic-training fault: training-step boundary (1-based) at which host
+    # ``host_loss_index``'s device group dies (resilience/elastic.py)
+    host_loss_step: Optional[int] = None
+    host_loss_index: int = 0
     # handoff faults: indices count the router's live-KV handoff ATTEMPTS
     # (0-based, fleet-wide — retries are attempts too, so (0, 1) drills a
     # first failure AND its retry)
@@ -105,6 +116,7 @@ class FaultPlan:
     sink: Optional[Callable[[dict], None]] = field(default=None, repr=False)
     _io_injected: int = field(default=0, repr=False)
     _sigterm_fired: bool = field(default=False, repr=False)
+    _host_loss_fired: bool = field(default=False, repr=False)
 
     def __post_init__(self):
         if self.nan_target not in ("grads", "loss"):
@@ -122,6 +134,7 @@ class FaultPlan:
         kill_step = env.get("ACCELERATE_CHAOS_REPLICA_KILL_STEP")
         rstall_step = env.get("ACCELERATE_CHAOS_REPLICA_STALL_STEP")
         hb_step = env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_STEP")
+        hl_step = env.get("ACCELERATE_CHAOS_HOST_LOSS_STEP")
         return cls(
             seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
             nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
@@ -138,6 +151,8 @@ class FaultPlan:
             replica_stall_index=int(env.get("ACCELERATE_CHAOS_REPLICA_STALL_INDEX", "0")),
             heartbeat_loss_step=int(hb_step) if hb_step else None,
             heartbeat_loss_index=int(env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_INDEX", "0")),
+            host_loss_step=int(hl_step) if hl_step else None,
+            host_loss_index=int(env.get("ACCELERATE_CHAOS_HOST_LOSS_INDEX", "0")),
             handoff_stall_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_STALL_AT")),
             handoff_loss_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_LOSS_AT")),
         )
@@ -153,6 +168,7 @@ class FaultPlan:
             or self.replica_kill_step is not None
             or self.replica_stall_step is not None
             or self.heartbeat_loss_step is not None
+            or self.host_loss_step is not None
             or self.handoff_stall_at
             or self.handoff_loss_at
         )
@@ -244,6 +260,23 @@ class FaultPlan:
             )
             return self.heartbeat_loss_index
         return None
+
+    # -- elastic-training hook (ElasticCoordinator per step boundary) --------
+
+    def host_loss(self, step: Optional[int], valid=None) -> Optional[int]:
+        """Index of the host whose device group dies at training-step
+        boundary ``step`` (1-based — the loss is detected BEFORE that step
+        runs), or None. Fires at most once; ``valid`` (the coordinator's
+        check: host index in range, not already lost, survivors still form a
+        mesh) gates the injection before it is recorded, like the fleet
+        hooks."""
+        if self._host_loss_fired or step is None or self.host_loss_step != step:
+            return None
+        if valid is not None and not valid(self.host_loss_index):
+            return None
+        self._host_loss_fired = True
+        self._record("host_loss", step=step, host=self.host_loss_index)
+        return self.host_loss_index
 
     def handoff_stall(self, attempt: int) -> Optional[float]:
         """Seconds to stall handoff attempt ``attempt`` mid-transfer, or

@@ -1,0 +1,931 @@
+"""Elastic training: survive data-parallel host loss without a job restart.
+
+PR 1 made host loss survivable at *checkpoint* granularity — lose a worker,
+relaunch the fleet, reload from disk, rewind the dataloader. On a preemptible
+pod that is minutes of lost work per eviction. This module closes the gap
+in-memory: because the ZeRO sharded update (parallel/zero.py, arXiv
+2004.13336) already stores the authoritative params/grads/optimizer state
+1/N over the data axes, losing a host destroys only the shards that lived on
+it — and a *buddy-redundant* copy of each shard (mirrored to a rank on a
+different host, Oobleck/Bamboo-style) means no shard has a single point of
+failure. Recovery is then a relayout, not a restart: pause at a step
+boundary, reassemble the state from surviving shards, reshard onto the
+shrunken N−k mesh (the same save→load reshard path PR 11 pinned bit-exact),
+re-partition the global batch over the survivors, recompile the step, and
+resume. Losing a host costs seconds, not a job.
+
+The degradation ladder (every rung chaos-drilled, mirroring the serving
+fleet's handoff ladder in serving/router.py):
+
+1. **buddy reshard** — redundancy on and the mirror fresh (refreshed at the
+   last step boundary): every lost shard is read from its buddy copy on a
+   surviving host; zero steps lost, recovery is mirror-read + reshard +
+   recompile.
+2. **checkpoint reload** — no redundancy, the buddy also died, or the mirror
+   is stale (``mirror_every > 1`` and the loss landed between refreshes — a
+   stale buddy mixed with fresh survivor shards would be a state from two
+   different steps, which is worse than losing steps): reload the newest
+   valid checkpoint onto the survivor mesh and rewind the dataloader
+   (fault_tolerance.py's machinery); steps since that checkpoint are lost.
+3. **fail loudly** — no checkpoint either: raise :class:`ElasticFailure`
+   naming what was tried. Silent corruption is never on the ladder.
+
+Regrow rides the same path in reverse: when the lost host revives, the live
+state (all shards readable — nothing lost) reshards onto the full mesh and
+the step recompiles once.
+
+Simulation model (what the CPU tests drill): the 8-device virtual mesh is
+partitioned into ``num_hosts`` contiguous host groups; "losing host i" makes
+every buffer on its devices unreadable from that instant — recovery code
+NEVER reads a shard on a lost device (enforced in
+:func:`assemble_from_survivors`, not assumed). On a real pod the same
+coordinator runs per-process with the supervisor's partial-failure signal
+(``pod-launch --elastic``) standing in for the chaos hook; the remaining
+multi-controller gap (jax.distributed re-rendezvous across surviving
+processes) is the ROADMAP's multi-slice-elasticity item. The host-relay
+reassembly (read surviving shards → host → device_put onto the new mesh,
+one leaf at a time to bound peak host memory) is the CPU stand-in for the
+2112.01075 device-to-device redistribution collective, exactly like the
+serving fleet's KV handoff.
+
+Everything is observable: every detection/recovery/regrow lands as a
+``{"kind": "elastic"}`` record in telemetry.jsonl with an ``mttr_s`` field,
+recovery wall time feeds the goodput ledger as ``elastic_reshard``, and the
+resharded step program is contract-gated like any other (the PR 8
+differential gate and the replication audit run against the shrunken mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ElasticFailure(RuntimeError):
+    """Every rung of the elastic degradation ladder failed (or the survivor
+    set cannot form a mesh). The run cannot continue correctly — failing
+    loudly here is the ladder's last rung, by design."""
+
+
+@dataclass
+class ElasticConfig:
+    """Opt-in elastic-training knobs (``Accelerator.elastic_coordinator``).
+
+    - ``redundancy`` — buddy copies of each rank's ZeRO shard (0 = none: the
+      ladder starts at the checkpoint rung). Each copy costs an extra
+      (params + optimizer state)/N of HBM per chip — priced by
+      ``estimate-memory --elastic-redundancy`` and recorded in telemetry
+      when the mirror is allocated. Only 0 and 1 are meaningful on a
+      single-roll mirror; values >1 are rejected.
+    - ``num_hosts`` — how many (simulated) hosts the device mesh divides
+      into; "host loss" removes one contiguous group of
+      ``num_devices/num_hosts`` devices. Defaults to ``jax.process_count()``
+      (the real-pod mapping: one process per host).
+    - ``mirror_every`` — refresh the buddy mirror every N completed steps.
+      1 (default) keeps the mirror always fresh so the buddy rung loses zero
+      steps; larger values cut mirror bandwidth but any loss landing between
+      refreshes falls through to the checkpoint rung (a stale mirror cannot
+      be mixed with fresh survivor shards — see the ladder).
+    - ``checkpoint_dir`` — where the checkpoint rung looks for the newest
+      valid checkpoint (``fault_tolerance.latest_valid_checkpoint``).
+    - ``contracts_dir`` — when set, the resharded step program is checked
+      against the checked-in contracts after every reshard (PR 8 gate; on a
+      shrunken mesh the env-pinned contract degrades to an explicit skip,
+      never fabricated drift — and the replication audit must stay clean).
+    - ``handle_signals`` — install a SIGUSR1 handler that flags a shrink
+      request for the next step boundary: the transport half of
+      ``pod-launch --elastic``, whose supervisor signals the SURVIVORS of a
+      partial failure instead of relaunching the fleet.
+    """
+
+    redundancy: int = 1
+    num_hosts: Optional[int] = None
+    mirror_every: int = 1
+    checkpoint_dir: Optional[str] = None
+    contracts_dir: Optional[str] = None
+    handle_signals: bool = False
+
+    def __post_init__(self):
+        if self.redundancy not in (0, 1):
+            raise ValueError(
+                f"ElasticConfig.redundancy must be 0 or 1 (one buddy roll), got {self.redundancy}"
+            )
+        if self.mirror_every < 1:
+            raise ValueError("ElasticConfig.mirror_every must be >= 1")
+
+
+# ---------------------------------------------------------------------------
+# host groups / buddy layout
+# ---------------------------------------------------------------------------
+
+
+def host_device_groups(devices: list, num_hosts: int) -> list[list]:
+    """Partition ``devices`` (mesh flat order) into ``num_hosts`` contiguous
+    groups — the simulation's host boundaries. Contiguity matters: the buddy
+    roll distance is one host's worth of ranks, so a shard and its buddy can
+    never share a host."""
+    n = len(devices)
+    if num_hosts < 1 or n % num_hosts != 0:
+        raise ValueError(
+            f"{n} devices do not divide into {num_hosts} equal hosts"
+        )
+    per = n // num_hosts
+    return [list(devices[i * per : (i + 1) * per]) for i in range(num_hosts)]
+
+
+def buddy_mesh(mesh: jax.sharding.Mesh, stride: int) -> jax.sharding.Mesh:
+    """The buddy placement mesh: the same axes over the device list rolled by
+    ``stride`` (= devices per host), so rank r's shard lands on rank
+    r+stride's device — a different host by construction. A buddy array is
+    simply the primary array ``device_put`` onto this mesh with the SAME
+    PartitionSpec: identical global value, shard-for-shard displaced one
+    host over."""
+    flat = mesh.devices.reshape(-1)
+    if not 0 < stride < flat.size:
+        raise ValueError(f"buddy stride {stride} out of range for {flat.size} devices")
+    rolled = np.roll(flat, stride).reshape(mesh.devices.shape)
+    return jax.sharding.Mesh(rolled, mesh.axis_names)
+
+
+def buddy_shardings(shardings: Any, bmesh: jax.sharding.Mesh) -> Any:
+    """Primary NamedShardings → the buddy layout (same specs, rolled mesh)."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(lambda s: NamedSharding(bmesh, s.spec), shardings)
+
+
+# ---------------------------------------------------------------------------
+# survivor-side reassembly (the honest read path: lost devices are unreadable)
+# ---------------------------------------------------------------------------
+
+
+def _index_key(index: tuple, shape: tuple) -> tuple:
+    """Normalize a shard's global-slice index so primary and buddy shards of
+    the same region compare equal (None-bounded slices vs explicit ones)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def assemble_from_survivors(
+    primary: jax.Array,
+    lost_ids: "set[int]",
+    buddy: Optional[jax.Array] = None,
+) -> Optional[np.ndarray]:
+    """Reassemble one global array on host from shards on SURVIVING devices
+    only — the elastic read primitive. Shards whose device id is in
+    ``lost_ids`` are never touched (the simulation's honesty guarantee: a
+    dead host's HBM is unreadable). Missing regions are filled from the
+    ``buddy`` copy's surviving shards; returns None when coverage is still
+    incomplete (primary and buddy both lost — the caller's ladder falls
+    through to the next rung)."""
+    shape = tuple(primary.shape)
+    out = np.empty(shape, dtype=primary.dtype)
+    needed = {
+        _index_key(idx, shape)
+        for idx in primary.sharding.devices_indices_map(shape).values()
+    }
+    have: set = set()
+    for source in (primary, buddy):
+        if source is None:
+            continue
+        for shard in source.addressable_shards:
+            if shard.device.id in lost_ids:
+                continue
+            key = _index_key(shard.index, shape)
+            if key in have:
+                continue
+            out[shard.index] = np.asarray(shard.data)
+            have.add(key)
+        if needed <= have:
+            return out
+    return None
+
+
+def _leaf_covered(primary: jax.Array, lost_ids: "set[int]", buddy=None) -> bool:
+    """Coverage pre-check WITHOUT reading any shard data: do the surviving
+    (primary ∪ buddy) shards tile the whole array? Walks sharding metadata
+    only, so the ladder can decide its rung before moving a byte."""
+    shape = tuple(primary.shape)
+    needed = {
+        _index_key(idx, shape)
+        for idx in primary.sharding.devices_indices_map(shape).values()
+    }
+    have: set = set()
+    for source in (primary, buddy):
+        if source is None:
+            continue
+        for device, idx in source.sharding.devices_indices_map(shape).items():
+            if device.id not in lost_ids:
+                have.add(_index_key(idx, shape))
+    return needed <= have
+
+
+def tree_covered(primary_tree: Any, lost_ids: "set[int]", buddy_tree: Any = None) -> bool:
+    """Whether every leaf of the tree survives the loss (metadata-only)."""
+    if buddy_tree is None:
+        flags = jax.tree.map(lambda p: _leaf_covered(p, lost_ids), primary_tree)
+    else:
+        flags = jax.tree.map(
+            lambda p, b: _leaf_covered(p, lost_ids, b), primary_tree, buddy_tree
+        )
+    return all(jax.tree.leaves(flags))
+
+
+def relay_tree(
+    primary_tree: Any,
+    lost_ids: "set[int]",
+    buddy_tree: Any,
+    new_shardings: Any,
+) -> Any:
+    """Relay a state tree onto a new mesh through surviving shards, ONE LEAF
+    AT A TIME: assemble the leaf on host, ``device_put`` it to its new
+    sharding, drop the host copy — peak host memory is bounded by the
+    largest leaf, never the whole state (the CPU analogue of 2112.01075's
+    no-full-buffer redistribution). Callers pre-check :func:`tree_covered`;
+    an uncovered leaf here is a programming error and raises."""
+
+    def _leaf(p, b, s):
+        host = assemble_from_survivors(p, lost_ids, b)
+        if host is None:
+            raise ElasticFailure(
+                "internal: relay_tree called for a leaf whose surviving "
+                "shards do not cover it (coverage must be checked first)"
+            )
+        return jax.device_put(host, s)
+
+    if buddy_tree is None:
+        return jax.tree.map(
+            lambda p, s: _leaf(p, None, s), primary_tree, new_shardings
+        )
+    return jax.tree.map(_leaf, primary_tree, buddy_tree, new_shardings)
+
+
+# ---------------------------------------------------------------------------
+# the coordinator
+# ---------------------------------------------------------------------------
+
+
+class ElasticCoordinator:
+    """Owns one training run's elastic lifecycle: the compiled step, the
+    buddy mirror, host-loss detection (chaos plan or supervisor signal), the
+    recovery ladder, and regrow.
+
+    Canonical loop (the compiled-step loop, elastics riding along)::
+
+        coordinator = accelerator.elastic_coordinator(
+            loss_fn, config=ElasticConfig(redundancy=1, num_hosts=2),
+            checkpoint_manager=manager,
+        )
+        for batch in loader:
+            loss = coordinator.step(batch)   # host-loss pauses, reshards, resumes here
+        # lost host came back:
+        coordinator.regrow()
+
+    ``step`` accepts host (numpy) batches or already-sharded global arrays;
+    host batches are sharded with the LIVE ``data_sharding`` so the global
+    batch re-partitions over the survivors automatically after a shrink —
+    same rows, fewer ranks, no example skipped or repeated (prepared
+    dataloaders do the same through their own live ``_globalize``).
+    """
+
+    def __init__(
+        self,
+        accelerator: Any,
+        loss_fn: Callable,
+        model: Any = None,
+        optimizer: Any = None,
+        config: Optional[ElasticConfig] = None,
+        checkpoint_manager: Any = None,
+        **step_kwargs: Any,
+    ):
+        self.accelerator = accelerator
+        self.config = config or ElasticConfig()
+        if model is None:
+            if not accelerator._models:
+                raise ValueError("ElasticCoordinator needs a prepared model.")
+            model = accelerator._models[-1]
+        self.model = model
+        if optimizer is None:
+            optimizer = next(
+                (o for o in accelerator._optimizers if o._box is self.model.box), None
+            )
+            if optimizer is None:
+                raise ValueError(
+                    "ElasticCoordinator needs an optimizer prepared for this "
+                    "model — call prepare_optimizer() first."
+                )
+        self.optimizer = optimizer
+        if self.optimizer.cpu_offload:
+            raise ValueError(
+                "ElasticCoordinator does not compose with cpu_offload "
+                "optimizer state (the buddy mirror and survivor reassembly "
+                "cover device shards); keep the state on-device or drop "
+                "elastic training."
+            )
+        self._loss_fn = loss_fn
+        self._step_kwargs = step_kwargs
+        self.checkpoint_manager = checkpoint_manager
+        if self.checkpoint_manager is not None and self.config.checkpoint_dir is None:
+            self.config = dataclasses.replace(
+                self.config, checkpoint_dir=self.checkpoint_manager.checkpoint_dir
+            )
+        num_hosts = self.config.num_hosts or max(int(jax.process_count()), 1)
+        # host groups are fixed over the ORIGINAL full mesh: regrow restores
+        # exactly these devices, and a second loss indexes the same groups
+        self._full_devices = list(self.accelerator.mesh.devices.reshape(-1))
+        # pinned with EXPLICIT axis sizes so a full regrow restores the
+        # original layout bit-for-bit (not a different equal-sized factoring)
+        shape = self.accelerator.mesh.shape
+        self._full_parallelism = dataclasses.replace(
+            accelerator.state.parallelism,
+            data=int(shape.get("data", 1)),
+            fsdp=int(shape.get("fsdp", 1)),
+        )
+        self.host_groups = host_device_groups(self._full_devices, num_hosts)
+        self.lost_hosts: set[int] = set()
+        self.completed_steps = 0
+        self._mirror_step = -1
+        self._buddy: Optional[dict] = None
+        self._shrink_requested = False
+        self._batch_struct = None
+        self.last_recovery: Optional[dict] = None
+        self.recoveries: list[dict] = []
+        self._recompile()
+        if self.config.redundancy:
+            self._mirror()
+        if self.config.handle_signals:
+            self._install_signal_handler()
+
+    def _install_signal_handler(self) -> None:
+        """SIGUSR1 → shrink request at the next boundary (the signal the
+        elastic pod supervisor sends survivors). Flag-only, exactly like
+        CheckpointManager's preemption handler — never reshard from a
+        handler: the interrupted step's state is inconsistent."""
+        import signal
+
+        try:
+            signal.signal(signal.SIGUSR1, lambda signum, frame: self.request_shrink())
+        except ValueError:
+            logger.warning(
+                "ElasticCoordinator could not install the SIGUSR1 handler "
+                "outside the main thread; call request_shrink() manually."
+            )
+
+    # -- surfaces ------------------------------------------------------------
+
+    @property
+    def mesh(self):
+        return self.accelerator.mesh
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.host_groups)
+
+    def surviving_devices(self) -> list:
+        lost = self._lost_device_ids(self.lost_hosts)
+        return [d for d in self._full_devices if d.id not in lost]
+
+    def shard_batch(self, batch: Any) -> Any:
+        """Place a host batch onto the LIVE mesh's data sharding (re-derived
+        every call, so post-shrink batches repartition over the survivors).
+
+        A DEVICE batch still laid out for a pre-shrink mesh is salvaged
+        through surviving shards only — the module's no-dead-reads invariant
+        holds for batches too (a plain ``np.asarray`` would gather the lost
+        host's buffers: silent in the simulation, a hang on real hardware).
+        Rows that lived only on lost devices are genuinely gone and raise
+        :class:`ElasticFailure` naming the two working patterns (feed host
+        batches, or let a prepared dataloader's next yield re-shard itself
+        from its retained host copy)."""
+        sharding = self.accelerator.state.data_sharding()
+        lost_ids = self._lost_device_ids(self.lost_hosts)
+
+        def _put(x):
+            if isinstance(x, jax.Array):
+                if x.sharding.mesh == self.mesh:
+                    return x
+                host = assemble_from_survivors(x, lost_ids)
+                if host is None:
+                    raise ElasticFailure(
+                        "a device batch laid out for the pre-shrink mesh has "
+                        "rows only on LOST devices — they cannot be read. "
+                        "Feed coordinator.step() host (numpy) batches, or "
+                        "iterate a prepared dataloader (its next batch "
+                        "re-shards itself onto the survivor mesh); the "
+                        "checkpoint rung replays positions via "
+                        "CheckpointManager.resumed_loader."
+                    )
+                return jax.device_put(host, sharding)
+            return jax.device_put(np.asarray(x), sharding)
+
+        return jax.tree.map(_put, batch)
+
+    def request_shrink(self) -> None:
+        """Out-of-band loss notification (the pod supervisor's SIGUSR1 /
+        peer-death signal): the next ``step`` boundary probes the chaos plan
+        for the lost host and reshards before stepping."""
+        self._shrink_requested = True
+
+    # -- the step ------------------------------------------------------------
+
+    def step(self, batch: Any):
+        """One training step with the elastic boundary check in front: a
+        host loss scheduled for this step (chaos) or signalled by the
+        supervisor pauses the run, walks the recovery ladder, and resumes on
+        the shrunken mesh — the step then executes there."""
+        lost = self._detect_loss()
+        if lost is not None:
+            self.reshard(lost)
+        from ..parallel.sharding import abstract_like
+
+        batch = self.shard_batch(batch)
+        self._batch_struct = abstract_like(batch)
+        loss = self._step(batch)
+        self.completed_steps += 1
+        if self.config.redundancy and self.completed_steps % self.config.mirror_every == 0:
+            self._mirror()
+        return loss
+
+    def _detect_loss(self) -> Optional[int]:
+        plan = getattr(getattr(self.accelerator, "resilience", None), "chaos", None)
+        requested, self._shrink_requested = self._shrink_requested, False
+        lost = None
+        if plan is not None:
+            boundary = self.completed_steps + 1  # 1-based, like the training chaos legs
+            lost = plan.host_loss(boundary, valid=self._loss_valid)
+            if lost is None and requested:
+                # supervisor-signalled: the plan carries which host (the
+                # probe); fire it regardless of the scheduled step
+                lost = plan.host_loss(plan.host_loss_step, valid=self._loss_valid)
+        if lost is None and requested:
+            # a shrink was requested but nothing can name the lost host —
+            # swallowing the signal silently would leave the run stepping
+            # toward a hung collective with no explanation. Today the chaos
+            # plan is the only host probe (a real pod additionally needs the
+            # multi-controller re-rendezvous — ROADMAP: multi-slice
+            # elasticity); say so where the operator will look.
+            logger.warning(
+                "elastic: shrink requested (supervisor signal) but no host "
+                "probe identified the lost host — no FaultPlan with "
+                "host_loss_step is armed. The run continues on the FULL mesh; "
+                "if a host is really gone, the next collective will hang. "
+                "Arm ACCELERATE_CHAOS_HOST_LOSS_STEP/_INDEX (drills) or call "
+                "coordinator.reshard(lost_host=...) directly."
+            )
+            telemetry = getattr(self.accelerator, "telemetry", None)
+            if telemetry is not None and telemetry.enabled:
+                telemetry.write_record(
+                    "elastic",
+                    {"event": "shrink_request_unresolved", "at_step": self.completed_steps},
+                )
+        return lost
+
+    def _loss_valid(self, host_index: int) -> bool:
+        if not 0 <= host_index < self.num_hosts or host_index in self.lost_hosts:
+            return False
+        # the survivors must still form a mesh — the strict model axes must
+        # divide and a batch axis must absorb the shrink — or the injection
+        # would drill nothing
+        remaining = len(self.surviving_devices()) - len(self.host_groups[host_index])
+        return remaining > 0 and self._shrunk_parallelism(remaining) is not None
+
+    # -- buddy mirror --------------------------------------------------------
+
+    def _devices_per_host(self) -> int:
+        return len(self._full_devices) // self.num_hosts
+
+    def _mirror(self) -> None:
+        """Refresh the buddy copy of the step-boundary state: params +
+        optimizer state (the authoritative 1/N shards) device_put onto the
+        rolled mesh. Gradients are recomputed, the scaler scalars are
+        replicated everywhere already — neither needs a buddy. With only one
+        host's devices left there is nowhere redundant to roll onto: the
+        mirror stands down (a further loss falls to the checkpoint rung)."""
+        per_host = self._devices_per_host()
+        if self.mesh.devices.size <= per_host:
+            if self._buddy is not None:
+                logger.warning(
+                    "elastic: one host's devices remain — buddy mirror stood "
+                    "down; a further loss degrades to the checkpoint rung."
+                )
+            self._buddy = None
+            return
+        bmesh = buddy_mesh(self.mesh, per_host)
+        p_sh = buddy_shardings(self.model.params_shardings, bmesh)
+        o_sh = buddy_shardings(self.optimizer._opt_state_device_shardings, bmesh)
+        first_mirror = self._buddy is None
+        self._buddy = {
+            "params": jax.device_put(self.model.params, p_sh),
+            "opt_state": jax.device_put(self.optimizer.opt_state, o_sh),
+        }
+        self._mirror_step = self.completed_steps
+        if first_mirror:
+            self._record_mirror_cost()
+
+    def _record_mirror_cost(self) -> None:
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        if telemetry is None or not telemetry.enabled:
+            return
+        from ..telemetry.memory import state_bytes_per_chip
+
+        telemetry.write_record(
+            "elastic",
+            {
+                "event": "redundancy_allocated",
+                "redundancy": self.config.redundancy,
+                "buddy_bytes_per_chip": state_bytes_per_chip(self._buddy["params"])
+                + state_bytes_per_chip(self._buddy["opt_state"]),
+                "mirror_every": self.config.mirror_every,
+            },
+        )
+
+    def _buddy_fresh(self) -> bool:
+        return self._buddy is not None and self._mirror_step == self.completed_steps
+
+    # -- recovery ladder -----------------------------------------------------
+
+    def _lost_device_ids(self, hosts) -> set:
+        return {d.id for h in hosts for d in self.host_groups[h]}
+
+    def _shrunk_parallelism(self, n_devices: int):
+        """The ParallelismConfig for ``n_devices`` survivors, or None when no
+        layout fits. The strict model axes (pipeline/expert/sequence/tensor)
+        are fixed — their collectives are baked into the program structure.
+        The BATCH axes absorb the shrink: data first (keeping fsdp), else
+        fsdp (keeping data — fsdp is a weight-update shard axis, resizable
+        like data). The full device set restores the original layout exactly
+        (regrow must not land on a different-but-equal-sized mesh)."""
+        if n_devices == len(self._full_devices):
+            return self._full_parallelism
+        par = self.accelerator.state.parallelism
+        shape = self.accelerator.mesh.shape
+        strict = int(
+            shape.get("pipeline", 1) * shape.get("expert", 1)
+            * shape.get("sequence", 1) * shape.get("tensor", 1)
+        )
+        data, fsdp = int(shape.get("data", 1)), int(shape.get("fsdp", 1))
+        if n_devices >= strict * fsdp and n_devices % (strict * fsdp) == 0:
+            return dataclasses.replace(par, data=n_devices // (strict * fsdp), fsdp=fsdp)
+        if n_devices >= strict * data and n_devices % (strict * data) == 0:
+            return dataclasses.replace(par, data=data, fsdp=n_devices // (strict * data))
+        return None
+
+    def reshard(self, lost_host: int) -> dict:
+        """Walk the degradation ladder for the loss of ``lost_host``; on
+        success the accelerator/model/optimizer live on the shrunken mesh
+        with a freshly compiled step. Raises :class:`ElasticFailure` from
+        the last rung."""
+        t0 = time.perf_counter()
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        telemetry = telemetry if (telemetry is not None and telemetry.enabled) else None
+        self.lost_hosts.add(lost_host)
+        lost_ids = self._lost_device_ids(self.lost_hosts)
+        survivors = self.surviving_devices()
+        if telemetry is not None:
+            telemetry.write_record(
+                "elastic",
+                {
+                    "event": "host_loss_detected",
+                    "host": lost_host,
+                    "lost_devices": sorted(lost_ids),
+                    "survivors": len(survivors),
+                    "at_step": self.completed_steps,
+                },
+            )
+        if not survivors or self._shrunk_parallelism(len(survivors)) is None:
+            # routed through _fail so a mesh-infeasible loss still records
+            # recovery_failed (a direct mid-ladder raise would bypass it)
+            raise self._fail(
+                lost_host, t0, telemetry,
+                tried=[],
+                reason=f"{len(survivors)} surviving devices cannot form a "
+                "training mesh (the strict model axes must divide and a "
+                "data/fsdp axis must absorb the shrink)",
+            )
+        from contextlib import nullcontext
+
+        pause = telemetry.pause("elastic_reshard") if telemetry is not None else nullcontext()
+        with pause:
+            return self._run_ladder(lost_host, lost_ids, survivors, t0, telemetry)
+
+    def _run_ladder(self, lost_host, lost_ids, survivors, t0, telemetry) -> dict:
+        tried: list[str] = []
+        rung = None
+        steps_lost = 0
+        scaler_host = self._read_scaler(lost_ids)
+
+        # rung 1: buddy reshard — only a FRESH mirror is usable (a stale one
+        # mixed with fresh survivor shards would be a state from two steps),
+        # and only when the surviving primary∪buddy shards tile every leaf
+        # (checked on sharding metadata, before a byte moves)
+        if self.config.redundancy:
+            tried.append("buddy")
+            if self._buddy_fresh():
+                if tree_covered(
+                    self.model.params, lost_ids, self._buddy["params"]
+                ) and tree_covered(
+                    self.optimizer.opt_state, lost_ids, self._buddy["opt_state"]
+                ):
+                    rung = "buddy"
+                else:
+                    logger.warning(
+                        "elastic: buddy rung failed — a shard and its mirror "
+                        "are both on lost devices; falling back to checkpoint."
+                    )
+            else:
+                logger.warning(
+                    "elastic: buddy mirror is stale (last refreshed at step "
+                    f"{self._mirror_step}, loss at step boundary "
+                    f"{self.completed_steps}); falling back to checkpoint."
+                )
+
+        ckpt_path = None
+        if rung is None and self.config.checkpoint_dir is not None:
+            from ..fault_tolerance import latest_valid_checkpoint
+
+            tried.append("checkpoint")
+            ckpt_path = latest_valid_checkpoint(self.config.checkpoint_dir)
+
+        if rung is None and ckpt_path is None:
+            raise self._fail(
+                lost_host, t0, telemetry, tried=tried,
+                reason="buddy mirror unavailable and no valid checkpoint found"
+                + (f" under {self.config.checkpoint_dir}" if self.config.checkpoint_dir else " (no checkpoint_dir configured)"),
+            )
+
+        # the mesh shrinks on every successful rung; state placement differs.
+        # The old-mesh arrays stay readable through the rebuild, so the buddy
+        # relay reads them leaf by leaf straight onto the new layouts.
+        self._rebuild_mesh(survivors)
+        self._reshard_layouts()
+        if rung == "buddy":
+            self._relay_state(lost_ids, self._buddy, scaler_host)
+        else:
+            rung = "checkpoint"
+            steps_lost = self._restore_checkpoint(ckpt_path)
+        self._recompile()
+        self._buddy = None
+        if self.config.redundancy:
+            self._mirror()  # stands down by itself when one host remains
+        gate = self._contract_gate()
+        mttr = time.perf_counter() - t0
+        report = {
+            "event": "recovered",
+            "rung": rung,
+            "tried": tried,
+            "host": lost_host,
+            "lost_devices": sorted(lost_ids),
+            "mesh": {axis: int(size) for axis, size in self.mesh.shape.items()},
+            "steps_lost": steps_lost,
+            "resumed_at_step": self.completed_steps,
+            "mttr_s": round(mttr, 4),
+        }
+        if gate is not None:
+            report["contract_gate"] = gate
+        if telemetry is not None:
+            telemetry.write_record("elastic", report)
+        self.last_recovery = report
+        self.recoveries.append(report)
+        logger.warning(
+            f"elastic: recovered from host {lost_host} loss via {rung} rung in "
+            f"{mttr:.2f}s on mesh {dict(self.mesh.shape)} ({steps_lost} steps lost)"
+        )
+        return report
+
+    def _fail(self, lost_host, t0, telemetry, tried, reason) -> ElasticFailure:
+        record = {
+            "event": "recovery_failed",
+            "rung": "fail",
+            "tried": tried,
+            "host": lost_host,
+            "reason": reason,
+            "mttr_s": round(time.perf_counter() - t0, 4),
+        }
+        if telemetry is not None:
+            telemetry.write_record("elastic", record)
+        self.last_recovery = record
+        self.recoveries.append(record)
+        return ElasticFailure(
+            f"elastic recovery from host {lost_host} loss failed after trying "
+            f"{tried or ['nothing']}: {reason}. The run cannot continue "
+            "correctly — restart from the last checkpoint, or enable "
+            "ElasticConfig(redundancy=1) / a checkpoint_dir for in-memory recovery."
+        )
+
+    def _read_scaler(self, lost_ids) -> Optional[dict]:
+        if self.optimizer.scaler is None:
+            return None
+        # replicated scalars: every survivor holds a full copy
+        return {
+            "scale": assemble_from_survivors(self.optimizer.scale, lost_ids),
+            "growth_tracker": assemble_from_survivors(self.optimizer.growth_tracker, lost_ids),
+        }
+
+    # -- relayout onto the current (shrunken or regrown) mesh -----------------
+
+    def _rebuild_mesh(self, devices: list) -> None:
+        state = self.accelerator.state
+        new_par = self._shrunk_parallelism(len(devices))
+        if new_par is None:
+            raise ElasticFailure(
+                f"internal: {len(devices)} devices cannot form a training "
+                "mesh (feasibility must be checked before the ladder runs)"
+            )
+        state._partial.rebuild_mesh(devices=devices, parallelism=new_par)
+        # ZeRO eligibility changes with the mesh (data=1 after a shrink has
+        # nothing to shard over); keep the accelerator's resolution honest
+        from ..parallel.zero import zero_eligible
+
+        self.accelerator._zero_update_sharding = (
+            zero_eligible(state.mesh, self.accelerator.fsdp_plugin)
+            and new_par.zero_stage != 0
+        )
+
+    def _reshard_layouts(self) -> None:
+        """Recompute params/optimizer shardings for the CURRENT mesh — the
+        same derivation prepare_model/prepare_optimizer ran, so the layouts
+        (and the reshard itself) stay on the PR 11 bit-exact path."""
+        from ..parallel.sharding import (
+            abstract_like,
+            infer_shardings,
+            shardings_like,
+            zero_update_shardings,
+        )
+
+        accelerator = self.accelerator
+        mesh = accelerator.mesh
+        params_struct = abstract_like(self.model.params)
+        rules = accelerator._partition_rules(self.model.module)
+        shardings = infer_shardings(params_struct, mesh, rules)
+        if accelerator._zero_update_sharding:
+            shardings = zero_update_shardings(params_struct, shardings, mesh)
+        self.model.params_shardings = shardings
+        optimizer = self.optimizer
+        optimizer._params_shardings = shardings
+        # ZeRO stage 1/2: params replicated but the MOMENTS shard over fsdp —
+        # the same opt_reference_shardings derivation prepare_optimizer ran
+        # (dropping it here would silently re-replicate the optimizer state,
+        # N× its HBM, after a recovery)
+        opt_reference = shardings
+        plugin = accelerator.fsdp_plugin
+        if plugin is not None and plugin.stage < 3:
+            opt_reference = infer_shardings(
+                params_struct, mesh, rules.with_fsdp_applied()
+            )
+        state_shapes = jax.eval_shape(optimizer.tx.init, params_struct)
+        optimizer._opt_state_shardings = shardings_like(
+            state_shapes, params_struct, opt_reference, mesh
+        )
+        optimizer._opt_state_device_shardings = optimizer._opt_state_shardings
+        # in-flight accumulation (if any) lived on the old mesh — drop it;
+        # the step boundary means no gradients are pending by contract
+        optimizer._grads = None
+        optimizer._accum_count = 0
+        optimizer._fingerprint_memo = None
+        optimizer._zeros_fn_memo = None
+        # the scaler scalars are NOT re-placed here: reading the live array
+        # could touch a lost device. The buddy relay re-places them from the
+        # survivor-read copy; the checkpoint rung's load_state_dict resets
+        # them from the manifest.
+        # the guard's device state + LKG snapshot live on the old mesh: disarm
+        # so the next guarded step re-arms on the new one
+        guard = getattr(getattr(accelerator, "resilience", None), "guard", None)
+        if guard is not None:
+            guard.state = None
+            guard._bound = None
+            if hasattr(guard, "_snapshot"):
+                guard._snapshot = None
+
+    def _relay_state(self, lost_ids: set, buddy: Optional[dict], scaler_host) -> None:
+        """Move params + optimizer state from the (old-mesh) surviving shards
+        onto the freshly derived layouts, one leaf at a time."""
+        from ..parallel.sharding import replicated
+
+        self.model.params = relay_tree(
+            self.model.params,
+            lost_ids,
+            buddy["params"] if buddy else None,
+            self.model.params_shardings,
+        )
+        self.optimizer.opt_state = relay_tree(
+            self.optimizer.opt_state,
+            lost_ids,
+            buddy["opt_state"] if buddy else None,
+            self.optimizer._opt_state_device_shardings,
+        )
+        if scaler_host is not None:
+            rep = replicated(self.mesh)
+            self.optimizer.scale = jax.device_put(scaler_host["scale"], rep)
+            self.optimizer.growth_tracker = jax.device_put(
+                scaler_host["growth_tracker"], rep
+            )
+
+    def _restore_checkpoint(self, path: str) -> int:
+        """The checkpoint rung: load the newest valid checkpoint onto the
+        (already shrunken) mesh — load_state reshards onto the live layouts,
+        the path PR 11 pinned bit-exact — and rewind the coordinator's step
+        counter + any prepared dataloaders to the checkpointed positions."""
+        from ..fault_tolerance import checkpoint_step
+
+        self.accelerator.load_state(path)
+        ckpt_step = checkpoint_step(path)
+        steps_lost = max(self.completed_steps - ckpt_step, 0)
+        self.completed_steps = ckpt_step
+        # dataloader rewind: the prepared loaders re-partition automatically
+        # (live data_sharding); their POSITION is the checkpoint's business —
+        # a CheckpointManager-driven loop replays via resumed_loader exactly
+        # like a cold resume (docs/fault_tolerance.md), so no example is
+        # skipped or repeated across the rung.
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            telemetry.goodput.mark_restart()
+        return steps_lost
+
+    def _recompile(self) -> None:
+        self._step = self.accelerator.compiled_step(
+            self._loss_fn, model=self.model, **self._step_kwargs
+        )
+
+    def _contract_gate(self) -> Optional[dict]:
+        """Run the PR 8 differential gate + replication audit over the
+        resharded step (needs a batch shape — stashed from the last step;
+        skipped before the first). Analyzer ERRORs raise: resuming on a
+        program that fails its own audit would trade a loud failure for a
+        silent one."""
+        if self.config.contracts_dir is None or self._batch_struct is None:
+            return None
+        report = self.accelerator.analyze(
+            step=self._step,
+            batch=self._batch_struct,
+            label="elastic_resharded_step",
+            write_record=False,
+            contracts_dir=self.config.contracts_dir,
+        )
+        if report.errors:
+            raise ElasticFailure(
+                "elastic: the resharded step failed its program audit:\n"
+                + report.render()
+            )
+        return {
+            "errors": 0,
+            "warnings": len(report.warnings),
+            "findings": len(report.findings),
+        }
+
+    # -- regrow ---------------------------------------------------------------
+
+    def regrow(self, hosts: Optional[list] = None) -> dict:
+        """Revived host(s) rejoin: reshard the LIVE survivor state onto the
+        regrown mesh (nothing is lost, so this is a pure relayout — the same
+        path as the shrink, read from every current shard) and recompile.
+        Default revives every lost host (back to the full mesh)."""
+        t0 = time.perf_counter()
+        revive = set(hosts) if hosts is not None else set(self.lost_hosts)
+        if not revive:
+            return {"event": "regrown", "hosts": [], "mttr_s": 0.0}
+        unknown = revive - self.lost_hosts
+        if unknown:
+            raise ValueError(f"cannot regrow hosts {sorted(unknown)}: not lost")
+        # everything on the CURRENT mesh is readable (nothing lost): the same
+        # per-leaf relay, reading every shard, placing onto the grown layouts
+        scaler_host = self._read_scaler(set())
+        self.lost_hosts -= revive
+        self._rebuild_mesh(self.surviving_devices())
+        self._reshard_layouts()
+        self._relay_state(set(), None, scaler_host)
+        self._recompile()
+        if self.config.redundancy:
+            self._mirror()
+        gate = self._contract_gate()
+        report = {
+            "event": "regrown",
+            "hosts": sorted(revive),
+            "mesh": {axis: int(size) for axis, size in self.mesh.shape.items()},
+            "resumed_at_step": self.completed_steps,
+            "mttr_s": round(time.perf_counter() - t0, 4),
+        }
+        if gate is not None:
+            report["contract_gate"] = gate
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            telemetry.write_record("elastic", report)
+        self.recoveries.append(report)
+        logger.info(
+            f"elastic: regrew hosts {sorted(revive)} onto mesh {dict(self.mesh.shape)} "
+            f"in {report['mttr_s']:.2f}s"
+        )
+        return report

@@ -135,6 +135,31 @@ class PartialState:
             device_array = np.asarray(devices).reshape(shape)
         self.mesh = jax.sharding.Mesh(device_array, CANONICAL_MESH_AXES)
 
+    def rebuild_mesh(
+        self,
+        devices: Optional[list] = None,
+        parallelism: Optional[ParallelismConfig] = None,
+    ) -> jax.sharding.Mesh:
+        """Rebuild the global mesh over an explicit device set — the elastic
+        shrink/regrow seam (resilience/elastic.py). ``devices`` defaults to
+        every device (a pure re-layout); a subset builds the survivor mesh
+        after a host loss. The new ``parallelism`` must exactly cover the
+        device count (``axis_sizes`` validates). Arrays placed on the old
+        mesh stay valid — callers reshard state explicitly; this only swaps
+        what NEW placements (``data_sharding``, ``infer_shardings``) see.
+        """
+        if parallelism is not None:
+            self.parallelism = parallelism
+        if devices is None:
+            self._build_mesh()
+            return self.mesh
+        axis_sizes = self.parallelism.axis_sizes(len(devices))
+        shape = tuple(axis_sizes[a] for a in CANONICAL_MESH_AXES)
+        self.mesh = jax.sharding.Mesh(
+            np.asarray(devices, dtype=object).reshape(shape), CANONICAL_MESH_AXES
+        )
+        return self.mesh
+
     # -- topology properties ----------------------------------------------
 
     @property

@@ -15,6 +15,10 @@ categories match where production runs actually bleed time:
                              burned without advancing training; resilience)
 - ``guard_restore``        — last-known-good restore after consecutive
                              non-finite steps (resilience/guards.py)
+- ``elastic_reshard``      — in-memory host-loss recovery: reassembling
+                             surviving/buddy shards, resharding onto the
+                             shrunken mesh, and recompiling the step
+                             (resilience/elastic.py)
 
 Productive time comes from the StepTimer (measured window time extrapolated
 over all steps), so the ratio needs no extra synchronization. The ledger is
@@ -34,6 +38,7 @@ CATEGORIES = (
     "startup",
     "guard_skipped",
     "guard_restore",
+    "elastic_reshard",
 )
 
 

@@ -1375,6 +1375,138 @@ def bench_resilience() -> dict:
     return result
 
 
+def bench_elastic() -> dict:
+    """Elastic-training drill + redundancy cost (resilience/elastic.py):
+
+    - **host-loss drill** — a chaos-injected loss of one data-parallel host
+      mid-training, recovered via the buddy rung: records the MTTR
+      (detection → resumed on the shrunken mesh), steps lost (0 for a fresh
+      mirror), and whether the post-recovery params are BIT-EQUAL a
+      reference run that recovered through the checkpoint rung onto the
+      same shrunken mesh (the PR 11 save→load reshard path) —
+      ``elastic_post_recovery_bit_equal`` is a measured flag, not a claim.
+    - **redundancy overhead** — paired windows (resilience_guard
+      methodology: same model/shape, best-of-windows each side) with the
+      buddy mirror ON vs OFF: ``elastic_redundancy_overhead_pct`` prices
+      the per-step mirror refresh (one 1/N-state device copy).
+    - **compile discipline** — after the ONE expected reshard recompile,
+      steady-state steps on the shrunken mesh must add 0 compiles
+      (``elastic_steady_state_compile_count``).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ElasticConfig, FaultPlan, ResilienceConfig
+    from accelerate_tpu.models import Bert
+    from accelerate_tpu.telemetry import CompileTracker
+    from accelerate_tpu.utils.random import set_seed
+
+    name = os.environ.get("BENCH_ELASTIC_MODEL", "bert-base")
+    batch_size = int(os.environ.get("BENCH_ELASTIC_BS", "8"))
+    seq_len = int(os.environ.get("BENCH_ELASTIC_SEQ", "128"))
+    n_steps = int(os.environ.get("BENCH_ELASTIC_STEPS", "6"))
+    loss_step = 4  # warm boundary: past the initial compile, mirror armed
+
+    def make_batch(model, accelerator):
+        rng = np.random.default_rng(0)
+        return {
+            "input_ids": np.asarray(
+                rng.integers(0, model.config.vocab_size, (batch_size, seq_len)), np.int32
+            ),
+            "attention_mask": np.ones((batch_size, seq_len), np.int32),
+            "labels": np.asarray(rng.integers(0, 2, (batch_size,)), np.int32),
+        }
+
+    def build(redundancy, fault_plan=None, ckpt_dir=None):
+        _reset_state()
+        set_seed(0)
+        accelerator = Accelerator(
+            resilience_config=(
+                ResilienceConfig(guard=None, fault_plan=fault_plan)
+                if fault_plan is not None
+                else None
+            ),
+        )
+        model = Bert(name)
+        prepared = accelerator.prepare_model(model)
+        optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+        coordinator = accelerator.elastic_coordinator(
+            Bert.loss_fn(model),
+            config=ElasticConfig(
+                redundancy=redundancy, num_hosts=2, checkpoint_dir=ckpt_dir
+            ),
+        )
+        return accelerator, model, prepared, optimizer, coordinator
+
+    # -- redundancy overhead: paired mirror-on/off windows --------------------
+    def elastic_rate(redundancy: int) -> float:
+        accelerator, model, prepared, optimizer, coordinator = build(redundancy)
+        batch = make_batch(model, accelerator)
+        for _ in range(3):
+            loss = coordinator.step(batch)
+        float(loss)
+        return _best_window_rate(coordinator.step, batch, n_steps=n_steps, windows=3)
+
+    rate_off = elastic_rate(0)
+    rate_on = elastic_rate(1)
+    overhead_pct = (rate_off / rate_on - 1.0) * 100.0 if rate_on > 0 else None
+
+    # -- host-loss drill: buddy rung + compile discipline ---------------------
+    import tempfile
+
+    def drill(redundancy: int, save_boundary=None):
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_elastic_ckpt_")
+        plan = FaultPlan(host_loss_step=loss_step, host_loss_index=1)
+        accelerator, model, prepared, optimizer, coordinator = build(
+            redundancy, fault_plan=plan, ckpt_dir=ckpt_dir
+        )
+        batch = make_batch(model, accelerator)
+        compiles = CompileTracker().start()
+        for _ in range(loss_step - 1):
+            coordinator.step(batch)
+        if save_boundary is not None:
+            accelerator.save_state(
+                os.path.join(ckpt_dir, f"checkpoint_{coordinator.completed_steps}"),
+                manifest_metadata={"step": coordinator.completed_steps},
+            )
+        coordinator.step(batch)  # recovery + the one expected reshard recompile
+        after_recovery = compiles.compile_count
+        steady = 5
+        for _ in range(steady):
+            loss = coordinator.step(batch)
+        float(loss)
+        steady_compiles = compiles.compile_count - after_recovery
+        compiles.stop()
+        params = jax.tree.map(np.asarray, prepared.params)
+        return coordinator, params, steady_compiles
+
+    coord_buddy, params_buddy, steady_compiles = drill(1)
+    coord_ref, params_ref, _ = drill(0, save_boundary=loss_step - 1)
+    bit_equal = all(
+        jax.tree.leaves(jax.tree.map(np.array_equal, params_buddy, params_ref))
+    )
+
+    recovery = coord_buddy.last_recovery or {}
+    return {
+        "elastic_model": name,
+        "elastic_step_rate_redundancy_off": round(rate_off, 3),
+        "elastic_step_rate_redundancy_on": round(rate_on, 3),
+        "elastic_redundancy_overhead_pct": (
+            round(overhead_pct, 2) if overhead_pct is not None else None
+        ),
+        "elastic_drill_rung": recovery.get("rung"),
+        "elastic_drill_mttr_s": recovery.get("mttr_s"),
+        "elastic_drill_steps_lost": recovery.get("steps_lost"),
+        "elastic_drill_mesh": recovery.get("mesh"),
+        "elastic_reference_rung": (coord_ref.last_recovery or {}).get("rung"),
+        "elastic_post_recovery_bit_equal": bool(bit_equal),
+        # after the one expected reshard recompile, the shrunken-mesh steady
+        # state must compile nothing
+        "elastic_steady_state_compile_count": steady_compiles,
+    }
+
+
 def bench_observability() -> dict:
     """Request-tracing subsystem cost (accelerate_tpu/telemetry/tracing.py):
 
@@ -1736,6 +1868,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "observability":
         print(json.dumps(bench_observability()))
         return
+    if os.environ.get("BENCH_ONLY") == "elastic":
+        print(json.dumps(bench_elastic()))
+        return
 
     device0 = jax.devices()[0]
     on_tpu = device0.platform == "tpu"
@@ -1780,6 +1915,7 @@ def main() -> None:
         ("resilience", bench_resilience, ()),
         ("analysis", bench_analysis, ()),
         ("observability", bench_observability, ()),
+        ("elastic", bench_elastic, ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport

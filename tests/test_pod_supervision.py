@@ -260,6 +260,117 @@ def test_relaunch_backoff_follows_retry_policy():
     assert sleeps == [0.5, 1.0]  # exponential, zero-jitter for determinism
 
 
+# -- elastic partial-failure mode (ISSUE 13 satellite): one dead worker
+# -- signals the survivors to shrink instead of relaunching the fleet
+
+
+class _ElasticProc(_FakeProc):
+    """Fake with a scripted exit schedule + signal recording."""
+
+    def __init__(self, schedule=(None,)):
+        super().__init__()
+        self.schedule = list(schedule)
+        self.signals = []
+
+    def poll(self):
+        if self._rc is not None:
+            return self._rc
+        self._rc = self.schedule.pop(0) if self.schedule else self._rc
+        return self._rc
+
+    def send_signal(self, signum):
+        self.signals.append(signum)
+
+
+def test_elastic_partial_failure_signals_survivors_and_continues():
+    """One worker dies; elastic mode notifies the survivors (SIGUSR1) and
+    keeps supervising them instead of killing the fleet — the job succeeds
+    when the shrunken fleet finishes."""
+    import signal
+
+    # worker 1 exits 3 immediately; 0 and 2 run a few polls then exit 0
+    procs = [
+        _ElasticProc([None, None, None, None, 0]),
+        _ElasticProc([3]),
+        _ElasticProc([None, None, None, None, 0]),
+    ]
+    rc = supervise(
+        lambda i: procs[i], 3, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+    )
+    assert rc == 0
+    assert procs[0].signals == [signal.SIGUSR1]
+    assert procs[2].signals == [signal.SIGUSR1]
+    assert not procs[0].killed and not procs[2].killed  # survivors never killed
+
+
+def test_elastic_heartbeat_silent_worker_is_killed_then_fleet_continues():
+    """A heartbeat-silent worker is operationally dead: elastic mode kills
+    it (instead of the whole fleet) and the remaining worker's clean exit
+    ends the job at 0. (The fake survivor finishes inside the heartbeat
+    window — fakes have no output pump to keep their heartbeat fresh.)"""
+    silent = _ElasticProc([None] * 1000)
+    healthy = _ElasticProc([None, None, None, 0])
+    procs = [healthy, silent]
+    start = time.monotonic()
+    rc = supervise(
+        lambda i: procs[i], 2, heartbeat_timeout=0.2, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+    )
+    assert rc == 0
+    assert silent.killed
+    assert not healthy.killed
+    assert time.monotonic() - start < 10
+
+
+def test_elastic_last_worker_failure_falls_back_to_relaunch_ladder():
+    """With no survivors left to shrink onto, elastic mode degrades to the
+    normal kill-and-relaunch ladder (here: restarts exhausted → exit code)."""
+    rc = supervise(
+        lambda i: _ElasticProc([5]), 1, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+    )
+    assert rc == 5
+
+
+def test_elastic_double_loss_shrinks_twice():
+    """Two separate worker deaths shrink the fleet twice; each surviving
+    round is re-signalled and the last worker finishing cleanly ends the
+    job at 0."""
+    procs = [
+        _ElasticProc([None] * 8 + [0]),
+        _ElasticProc([2]),
+        _ElasticProc([None, None, 4]),
+    ]
+    rc = supervise(
+        lambda i: procs[i], 3, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+    )
+    assert rc == 0
+    assert len(procs[0].signals) == 2  # notified for both losses
+
+
+def test_supervise_rejects_unknown_partial_failure_mode():
+    with pytest.raises(ValueError, match="partial_failure"):
+        supervise(lambda i: _FakeProc(0), 1, partial_failure="nope")
+
+
+def test_cli_elastic_requires_num_workers():
+    import argparse
+
+    from accelerate_tpu.commands.pod import run
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, num_workers=None, restart_on_failure=0,
+        heartbeat_timeout=0.0, elastic=True, training_script="train.py",
+        training_script_args=[],
+    )
+    with pytest.raises(ValueError, match="num_workers"):
+        run(args)
+
+
 def test_default_restart_policy_is_jittered_backoff():
     from accelerate_tpu.commands.pod import RESTART_POLICY
 
