@@ -35,9 +35,13 @@ from .resilience import (
     ElasticCoordinator,
     ElasticFailure,
     FaultPlan,
+    FilesystemStore,
     GuardPolicy,
+    MembershipConfig,
+    MembershipService,
     ResilienceConfig,
     RetryPolicy,
+    StaleEpochError,
 )
 from .telemetry import Telemetry, TelemetryConfig
 from .parallel.local_sgd import LocalSGD

@@ -1511,7 +1511,13 @@ class Accelerator:
         accelerator's compiled step with in-memory host-loss recovery:
         buddy-redundant ZeRO shards, live mesh shrink/regrow, and the
         chaos-drilled degradation ladder (buddy reshard → checkpoint reload
-        → fail loudly). See docs/resilience.md § Elastic training."""
+        → fail loudly). Pass ``membership=MembershipService(...)`` (or run
+        under ``pod-launch --elastic --membership_dir``) to arm the
+        epoch-fenced failure detector that NAMES the lost host — heartbeat
+        silence, step-stamp stalls, and supervisor-published deaths all
+        resolve to a concrete ``reshard(lost_host=...)``. See
+        docs/resilience.md § Elastic training / § Failure detection &
+        membership."""
         from .resilience.elastic import ElasticCoordinator
 
         return ElasticCoordinator(self, loss_fn, model=model, **kwargs)

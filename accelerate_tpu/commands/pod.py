@@ -85,6 +85,16 @@ def register_subcommand(subparsers):
         "instead of killing and relaunching everything. Needs --num_workers.",
     )
     parser.add_argument(
+        "--membership_dir", default=None, metavar="PATH",
+        help="Rendezvous-store directory for the membership service "
+        "(resilience/membership.py) — typically a GCS-fuse mount every "
+        "worker sees. The supervisor publishes a dead worker's index there "
+        "(it always knew who died; now the survivors do too), and the path "
+        "is exported to workers as ACCELERATE_MEMBERSHIP_DIR so an "
+        "unmodified training script's ElasticCoordinator resolves the "
+        "SIGUSR1 to a NAMED host. Needs --elastic.",
+    )
+    parser.add_argument(
         "--auto_resume", action="store_true",
         help="On a supervised relaunch, append `--resume auto` to the training "
         "script args so every worker continues from the newest VALID checkpoint "
@@ -110,6 +120,11 @@ def assemble_worker_command(args, resume: bool = False) -> str:
         parts.append(f"cd {shlex.quote(args.workdir)}")
     exports = list(args.env)
     exports.append("ACCELERATE_IN_TPU_POD=1")
+    membership_dir = getattr(args, "membership_dir", None)
+    if membership_dir:
+        # the membership transport: every worker's ElasticCoordinator picks
+        # the store up from this var (MembershipService.from_env)
+        exports.append(f"ACCELERATE_MEMBERSHIP_DIR={membership_dir}")
     for item in exports:
         if "=" not in item:
             raise ValueError(f"--env expects KEY=VALUE, got {item!r}")
@@ -194,6 +209,7 @@ def supervise(
     restart_policy: Optional[RetryPolicy] = None,
     partial_failure: str = "relaunch",
     elastic_signal=signal_mod.SIGUSR1,
+    membership_dir: Optional[str] = None,
 ) -> int:
     """Run ``spawn(i) -> Popen`` for every worker and monitor the fleet.
 
@@ -213,6 +229,14 @@ def supervise(
     shrunken fleet. The job succeeds when every remaining worker exits 0;
     only the LAST worker's failure falls through to the kill-and-relaunch
     ladder. Losing a host then costs a reshard, not a fleet restart.
+
+    With ``membership_dir`` the supervisor also PUBLISHES the dead worker's
+    index into the membership rendezvous store before signalling — the
+    supervisor always knew who died (exit code / heartbeat silence) and
+    used to throw that away, leaving the survivors' ``request_shrink()``
+    unresolved. Now SIGUSR1 arrives with an answer attached: the training
+    side's :class:`~...resilience.membership.MembershipService` reads the
+    ``lost/<i>`` record and the elastic ladder runs against a *named* host.
 
     ``spawn`` may accept a second ``attempt`` argument (1-based): relaunch
     attempts then get a different command — the auto-resume path appends
@@ -264,6 +288,19 @@ def supervise(
                 dead = next(w for w in workers if w.index == failed[0])
                 dead.kill()  # a heartbeat-silent process is operationally dead
                 workers = [w for w in workers if w is not dead]
+                if membership_dir:
+                    # name the lost host BEFORE the signal lands, so the
+                    # survivors' next boundary probe finds the answer waiting
+                    from ..resilience.membership import publish_supervisor_loss
+
+                    try:
+                        publish_supervisor_loss(membership_dir, failed[0], failed[2])
+                    except OSError as e:
+                        print(
+                            f"pod-launch: could not publish lost worker "
+                            f"{failed[0]} to membership store: {e}",
+                            file=sys.stderr,
+                        )
                 notified = sum(1 for w in workers if w.notify(elastic_signal))
                 # the survivors now pause to reassemble + recompile, printing
                 # nothing — restart their heartbeat clocks so the reshard gets
@@ -308,6 +345,13 @@ def supervise(
 def run(args) -> int:
     auto_resume = getattr(args, "auto_resume", False)
     elastic = getattr(args, "elastic", False)
+    membership_dir = getattr(args, "membership_dir", None)
+    if membership_dir and not elastic:
+        raise ValueError(
+            "--membership_dir only matters in partial-failure mode — the "
+            "supervisor publishes the dead worker's index for the SURVIVORS' "
+            "elastic shrink; pass --elastic too"
+        )
     command = assemble_worker_command(args)
     if args.num_workers is None:
         if args.restart_on_failure or args.heartbeat_timeout or auto_resume or elastic:
@@ -363,4 +407,5 @@ def run(args) -> int:
         restarts=args.restart_on_failure,
         heartbeat_timeout=args.heartbeat_timeout,
         partial_failure="elastic" if elastic else "relaunch",
+        membership_dir=membership_dir,
     )

@@ -17,16 +17,33 @@ serving path. Four legs (see docs/resilience.md):
   loader, and pod-launch relaunches;
 - :mod:`~.elastic` — in-memory host-loss recovery for training: buddy-
   redundant ZeRO shards, live mesh shrink/regrow, and a chaos-drilled
-  degradation ladder (buddy reshard → checkpoint reload → fail loudly).
+  degradation ladder (buddy reshard → checkpoint reload → fail loudly);
+- :mod:`~.membership` — epoch-fenced heartbeat membership with a pluggable
+  rendezvous store: the failure detector that turns heartbeat silence, a
+  step-stamp stall, or a supervisor publication into a NAMED lost host for
+  the elastic ladder, plus join-record re-admission for revived hosts;
+- :mod:`~.detector` — the one wall-clock silence primitive shared by the
+  serving fleet's replica heartbeat and the membership detector.
 
 Everything reports through the Telemetry hub as ``{"kind": "resilience"}``
-records in ``telemetry.jsonl``.
+(and ``{"kind": "membership"}``) records in ``telemetry.jsonl``.
 """
 
 from .chaos import FaultPlan
+from .detector import SilenceDetector
 from .elastic import ElasticConfig, ElasticCoordinator, ElasticFailure
 from .guards import GuardPolicy, NumericalGuard, tree_all_finite, zero_guard_state
 from .hub import Resilience, ResilienceConfig
+from .membership import (
+    STORE_RETRY,
+    CollectiveHangWatchdog,
+    FilesystemStore,
+    MembershipConfig,
+    MembershipService,
+    MembershipStore,
+    StaleEpochError,
+    publish_supervisor_loss,
+)
 from .retry import (
     DEFAULT_IO_RETRY,
     FLEET_RETRY,
@@ -40,14 +57,23 @@ __all__ = [
     "DEFAULT_IO_RETRY",
     "FLEET_RETRY",
     "HANDOFF_RETRY",
+    "STORE_RETRY",
+    "CollectiveHangWatchdog",
     "ElasticConfig",
     "ElasticCoordinator",
     "ElasticFailure",
     "FaultPlan",
+    "FilesystemStore",
+    "MembershipConfig",
+    "MembershipService",
+    "MembershipStore",
+    "SilenceDetector",
+    "StaleEpochError",
     "is_fleet_transient",
     "is_handoff_transient",
     "GuardPolicy",
     "NumericalGuard",
+    "publish_supervisor_loss",
     "Resilience",
     "ResilienceConfig",
     "RetryPolicy",

@@ -38,6 +38,16 @@ Fault legs:
   :class:`~.elastic.ElasticCoordinator` must recover through its degradation
   ladder (buddy reshard → checkpoint reload → fail loudly) before the step
   runs. Fires at most once;
+- ``membership_silence_step`` / ``membership_silence_index`` — the failure-
+  detection drill (resilience/membership.py): from the chosen training-step
+  boundary on, host ``index``'s heartbeat publisher is PERSISTENTLY silent
+  (a dead process never beats again) — the membership detector, not a chaos
+  probe, must turn the silence into a *named* lost host;
+- ``membership_stall_step`` / ``membership_stall_index`` — the wedged-rank
+  drill: from the chosen boundary on, host ``index``'s heartbeats keep
+  flowing but its published step-stamp FREEZES (alive process, rank stuck
+  in a collective) — the detector's step-stall leg must name it while the
+  silence leg stays quiet;
 - ``handoff_stall_at`` / ``handoff_loss_at`` — disaggregated-serving drills
   over the router's live-KV handoff *attempts* (0-based attempt indices,
   fleet-wide): a stalled attempt sleeps ``stall_seconds`` mid-transfer (slow
@@ -104,6 +114,14 @@ class FaultPlan:
     # ``host_loss_index``'s device group dies (resilience/elastic.py)
     host_loss_step: Optional[int] = None
     host_loss_index: int = 0
+    # membership faults (resilience/membership.py): PERSISTENT from the
+    # chosen training-step boundary (1-based) on — silence stops the host's
+    # heartbeat publisher, stall freezes its published step-stamp while the
+    # beats keep coming
+    membership_silence_step: Optional[int] = None
+    membership_silence_index: int = 0
+    membership_stall_step: Optional[int] = None
+    membership_stall_index: int = 0
     # handoff faults: indices count the router's live-KV handoff ATTEMPTS
     # (0-based, fleet-wide — retries are attempts too, so (0, 1) drills a
     # first failure AND its retry)
@@ -117,6 +135,8 @@ class FaultPlan:
     _io_injected: int = field(default=0, repr=False)
     _sigterm_fired: bool = field(default=False, repr=False)
     _host_loss_fired: bool = field(default=False, repr=False)
+    _membership_silence_recorded: bool = field(default=False, repr=False)
+    _membership_stall_recorded: bool = field(default=False, repr=False)
 
     def __post_init__(self):
         if self.nan_target not in ("grads", "loss"):
@@ -135,6 +155,8 @@ class FaultPlan:
         rstall_step = env.get("ACCELERATE_CHAOS_REPLICA_STALL_STEP")
         hb_step = env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_STEP")
         hl_step = env.get("ACCELERATE_CHAOS_HOST_LOSS_STEP")
+        ms_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_STEP")
+        mst_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_STALL_STEP")
         return cls(
             seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
             nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
@@ -153,6 +175,14 @@ class FaultPlan:
             heartbeat_loss_index=int(env.get("ACCELERATE_CHAOS_HEARTBEAT_LOSS_INDEX", "0")),
             host_loss_step=int(hl_step) if hl_step else None,
             host_loss_index=int(env.get("ACCELERATE_CHAOS_HOST_LOSS_INDEX", "0")),
+            membership_silence_step=int(ms_step) if ms_step else None,
+            membership_silence_index=int(
+                env.get("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_INDEX", "0")
+            ),
+            membership_stall_step=int(mst_step) if mst_step else None,
+            membership_stall_index=int(
+                env.get("ACCELERATE_CHAOS_MEMBERSHIP_STALL_INDEX", "0")
+            ),
             handoff_stall_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_STALL_AT")),
             handoff_loss_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_LOSS_AT")),
         )
@@ -169,6 +199,8 @@ class FaultPlan:
             or self.replica_stall_step is not None
             or self.heartbeat_loss_step is not None
             or self.host_loss_step is not None
+            or self.membership_silence_step is not None
+            or self.membership_stall_step is not None
             or self.handoff_stall_at
             or self.handoff_loss_at
         )
@@ -277,6 +309,39 @@ class FaultPlan:
         self._host_loss_fired = True
         self._record("host_loss", step=step, host=self.host_loss_index)
         return self.host_loss_index
+
+    def membership_silent(self, host: int, boundary: int) -> bool:
+        """Whether ``host``'s heartbeat publisher is silent at training-step
+        boundary ``boundary`` (1-based, like ``host_loss``). PERSISTENT from
+        the armed boundary on — a dead process never beats again — so unlike
+        the one-shot legs this returns True every later boundary; the ledger
+        records the onset once."""
+        if (
+            self.membership_silence_step is None
+            or host != self.membership_silence_index
+            or boundary < self.membership_silence_step
+        ):
+            return False
+        if not self._membership_silence_recorded:
+            self._membership_silence_recorded = True
+            self._record("membership_silence", step=boundary, host=host)
+        return True
+
+    def membership_stall(self, host: int, boundary: int) -> Optional[int]:
+        """The FROZEN step-stamp ``host`` publishes from boundary
+        ``boundary`` on (heartbeats keep flowing, the step stops advancing —
+        a rank wedged in a collective), or None when the host is healthy.
+        The frozen value is the last step completed before the wedge."""
+        if (
+            self.membership_stall_step is None
+            or host != self.membership_stall_index
+            or boundary < self.membership_stall_step
+        ):
+            return None
+        if not self._membership_stall_recorded:
+            self._membership_stall_recorded = True
+            self._record("membership_stall", step=boundary, host=host)
+        return max(self.membership_stall_step - 1, 0)
 
     def handoff_stall(self, attempt: int) -> Optional[float]:
         """Seconds to stall handoff attempt ``attempt`` mid-transfer, or

@@ -1,0 +1,55 @@
+"""The one wall-clock failure-detector primitive, shared by every subsystem
+that must decide "is this thing still alive?" from the *absence* of evidence.
+
+Two subsystems grew that decision independently: the serving fleet's replica
+heartbeat (``serving/fleet.py`` — a busy replica that made no step progress
+within ``heartbeat_timeout_s`` is operationally dead) and the training
+membership service (``resilience/membership.py`` — a host whose published
+heartbeat went silent, or whose step-stamp froze while peers advanced, is a
+lost or wedged rank). Timeout semantics that drift between them are a
+production incident waiting to happen (the fleet fails a replica over at T
+while membership still counts the same silence as healthy at T+ε), so the
+primitive lives here ONCE and both parameterize it.
+
+Semantics, pinned by tests on both consumers:
+
+- silence is **strictly more** than ``timeout_s`` elapsed since ``last_seen``
+  (elapsed == timeout is still alive — a probe that fires exactly on the
+  boundary must not kill a healthy peer);
+- ``timeout_s=None`` disables the detector (never silent) — the serving
+  fleet's default, where an in-process fleet steps synchronously;
+- the detector is **clock-agnostic**: callers pass ``last_seen``/``now`` from
+  whichever clock they own (the fleet uses ``time.monotonic`` within one
+  process; membership uses wall time, the only clock that crosses a store).
+  The default ``now`` is monotonic, matching the in-process consumer.
+
+This is the timeout half of a phi-accrual detector; the membership service
+layers the step-stamp stall check (peer progress as evidence) on top of the
+same primitive.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class SilenceDetector:
+    """Declares silence when more than ``timeout_s`` passed since last
+    evidence of life. ``None`` disables (never silent)."""
+
+    timeout_s: Optional[float] = None
+
+    def silent_for(self, last_seen: float, now: Optional[float] = None) -> float:
+        """Seconds since the last evidence of life (clock supplied by the
+        caller; defaults to ``time.monotonic()``)."""
+        return (time.monotonic() if now is None else now) - last_seen
+
+    def expired(self, last_seen: float, now: Optional[float] = None) -> bool:
+        """True when the silence exceeds the timeout — strictly: exactly
+        ``timeout_s`` of silence is still alive."""
+        if self.timeout_s is None:
+            return False
+        return self.silent_for(last_seen, now) > self.timeout_s

@@ -39,10 +39,13 @@ partitioned into ``num_hosts`` contiguous host groups; "losing host i" makes
 every buffer on its devices unreadable from that instant — recovery code
 NEVER reads a shard on a lost device (enforced in
 :func:`assemble_from_survivors`, not assumed). On a real pod the same
-coordinator runs per-process with the supervisor's partial-failure signal
-(``pod-launch --elastic``) standing in for the chaos hook; the remaining
-multi-controller gap (jax.distributed re-rendezvous across surviving
-processes) is the ROADMAP's multi-slice-elasticity item. The host-relay
+coordinator runs per-process; *naming* the lost host is the ``membership=``
+probe's job (:mod:`~.membership`: epoch-fenced heartbeats, the
+silence/step-stall failure detector, supervisor-published deaths, and
+join-record re-admission), with the chaos hook standing in for drills. The
+``jax.distributed`` re-rendezvous across surviving processes sits behind
+``PartialState.rejoin()`` (env-gated on real hardware); validating it on a
+pod is the ROADMAP's multi-slice-elasticity remainder. The host-relay
 reassembly (read surviving shards → host → device_put onto the new mesh,
 one leaf at a time to bound peak host memory) is the CPU stand-in for the
 2112.01075 device-to-device redistribution collective, exactly like the
@@ -310,6 +313,7 @@ class ElasticCoordinator:
         optimizer: Any = None,
         config: Optional[ElasticConfig] = None,
         checkpoint_manager: Any = None,
+        membership: Any = None,
         **step_kwargs: Any,
     ):
         self.accelerator = accelerator
@@ -364,6 +368,43 @@ class ElasticCoordinator:
         self._batch_struct = None
         self.last_recovery: Optional[dict] = None
         self.recoveries: list[dict] = []
+        # the membership probe (resilience/membership.py): epoch-fenced
+        # heartbeats + failure detector, so a supervisor signal — or plain
+        # heartbeat silence — resolves to a NAMED lost host instead of the
+        # PR 12 warning. Explicit object, or ACCELERATE_MEMBERSHIP_DIR (the
+        # pod-launch --elastic --membership_dir transport).
+        if membership is None:
+            from .membership import MembershipService
+
+            # one membership identity per host: an out-of-range process
+            # index raises in the service (aliasing identities would mask
+            # a real death), so the mismatch surfaces at construction
+            membership = MembershipService.from_env(
+                num_hosts=len(self.host_groups),
+                host_index=int(jax.process_index()),
+            )
+        self.membership = membership
+        self._hang_watchdog = None
+        self._last_membership_io: Optional[float] = None
+        # single-controller simulation publishes one beat per SIMULATED
+        # host; a real multi-process pod must publish ONLY its own — peers
+        # refreshing a dead host's record would blind the silence detector
+        self._sim_publish = int(jax.process_count()) <= 1
+        if self.membership is not None:
+            if self.membership.num_hosts != len(self.host_groups):
+                raise ValueError(
+                    f"membership service tracks {self.membership.num_hosts} hosts "
+                    f"but the coordinator simulates {len(self.host_groups)} — the "
+                    "two views would name different hosts for the same rank"
+                )
+            if self.membership.telemetry is None:
+                self.membership.telemetry = getattr(self.accelerator, "telemetry", None)
+            hang_timeout = self.membership.config.hang_watchdog_timeout_s
+            if hang_timeout is not None:
+                from .membership import CollectiveHangWatchdog
+
+                self._hang_watchdog = CollectiveHangWatchdog(self.membership, hang_timeout)
+        self.signals_armed = False
         self._recompile()
         if self.config.redundancy:
             self._mirror()
@@ -379,10 +420,17 @@ class ElasticCoordinator:
 
         try:
             signal.signal(signal.SIGUSR1, lambda signum, frame: self.request_shrink())
+            self.signals_armed = True
         except ValueError:
+            # signal.signal only works on the main thread: a library-embedded
+            # coordinator (server thread, notebook executor) must still
+            # construct — degrade to a warning and an unarmed handler
+            # (``signals_armed`` stays False so callers can check)
             logger.warning(
                 "ElasticCoordinator could not install the SIGUSR1 handler "
-                "outside the main thread; call request_shrink() manually."
+                "outside the main thread — handler left UNARMED; call "
+                "request_shrink() from your own signal plumbing, or rely on "
+                "the membership= probe (which needs no signal at all)."
             )
 
     # -- surfaces ------------------------------------------------------------
@@ -444,23 +492,145 @@ class ElasticCoordinator:
 
     def step(self, batch: Any):
         """One training step with the elastic boundary check in front: a
-        host loss scheduled for this step (chaos) or signalled by the
-        supervisor pauses the run, walks the recovery ladder, and resumes on
-        the shrunken mesh — the step then executes there."""
-        lost = self._detect_loss()
+        host loss scheduled for this step (chaos), signalled by the
+        supervisor, or named by the membership detector pauses the run,
+        walks the recovery ladder, and resumes on the shrunken mesh — the
+        step then executes there. A pending membership join record turns
+        into ``regrow()`` at the same boundary (re-admission without a
+        barrier stall)."""
+        membership_due = False
+        if self.membership is not None:
+            # an explicit shrink request forces a FULL membership boundary
+            # (publish, then detect) regardless of the throttle — detection
+            # must read beats from THIS boundary, not interval-stale ones
+            membership_due = self._membership_due() or self._shrink_requested
+            if membership_due:
+                self._membership_boundary()
+        lost = self._detect_loss(membership_due)
         if lost is not None:
             self.reshard(lost)
         from ..parallel.sharding import abstract_like
 
         batch = self.shard_batch(batch)
         self._batch_struct = abstract_like(batch)
-        loss = self._step(batch)
+        if self._hang_watchdog is not None:
+            self._hang_watchdog.arm()
+        try:
+            loss = self._step(batch)
+        finally:
+            if self._hang_watchdog is not None:
+                self._hang_watchdog.disarm()
         self.completed_steps += 1
         if self.config.redundancy and self.completed_steps % self.config.mirror_every == 0:
             self._mirror()
         return loss
 
-    def _detect_loss(self) -> Optional[int]:
+    def _membership_due(self) -> bool:
+        """Whether this boundary does membership store work. Throttled by
+        ``MembershipConfig.min_probe_interval_s`` so sub-second steps on a
+        network-filesystem store don't pay fsync'd I/O per step; 0 (the
+        default, and every drill) probes every boundary."""
+        interval = self.membership.config.min_probe_interval_s
+        if interval <= 0:
+            return True
+        now = time.monotonic()
+        if self._last_membership_io is None or now - self._last_membership_io >= interval:
+            self._last_membership_io = now
+            return True
+        return False
+
+    def _membership_degraded(self, op: str, error: Exception) -> None:
+        """Store weather outlasted STORE_RETRY: degrade THIS boundary's
+        membership work to a warning instead of killing the training run
+        the service exists to protect — losing one boundary of detection is
+        strictly better than losing the job. The next boundary retries."""
+        logger.warning(
+            f"elastic: membership {op} degraded (store unreachable past its "
+            f"retry budget: {error}); detection skipped this boundary."
+        )
+        try:
+            self.membership._record(
+                "store_degraded", {"op": op, "error": str(error)}
+            )  # telemetry is local — no store I/O on this path
+        except Exception:  # noqa: BLE001 - degradation reporting must not raise
+            pass
+
+    def _membership_boundary(self) -> None:
+        """The membership half of the step boundary: admit pending joins
+        (turning the revived host's join record into ``regrow()``), then
+        publish this boundary's heartbeats. Under the single controller the
+        coordinator publishes one beat per SIMULATED host (chaos legs
+        silence or freeze individual hosts); on a real pod each process
+        publishes only its own through the identical surface. Store I/O
+        failures degrade (see :meth:`_membership_degraded`); a failure
+        inside ``regrow`` itself stays loud — that is recovery, not
+        bookkeeping."""
+        try:
+            pending = self.membership.pending_joins()
+        except Exception as e:  # noqa: BLE001 - store weather must not kill the run
+            self._membership_degraded("pending_joins", e)
+            pending = []
+        joins = [h for h in pending if h in self.lost_hosts]
+        if joins:
+            self.regrow(hosts=joins)
+        for host in pending:
+            if host in joins or host in self.lost_hosts:
+                continue
+            # a join record this coordinator cannot regrow (the host was
+            # never lost from ITS mesh — e.g. the coordinator restarted, or
+            # the record is moot because the host is already a member):
+            # resolve it at the membership level so it doesn't re-list
+            # forever and the joiner doesn't wait on nobody
+            try:
+                if host in self.membership.view()["members"]:
+                    self.membership.store.delete(f"join/{host}")
+                else:
+                    self.membership.admit(host)
+            except Exception as e:  # noqa: BLE001
+                self._membership_degraded("admit_stale_join", e)
+        plan = getattr(getattr(self.accelerator, "resilience", None), "chaos", None)
+        boundary = self.completed_steps + 1  # 1-based, like host_loss
+        publish_for = (
+            range(self.num_hosts) if self._sim_publish else (self.membership.host_index,)
+        )
+        for host in publish_for:
+            if host in self.lost_hosts:
+                continue
+            step = self.completed_steps
+            if plan is not None:
+                if plan.membership_silent(host, boundary):
+                    continue
+                frozen = plan.membership_stall(host, boundary)
+                if frozen is not None:
+                    step = frozen
+            try:
+                self.membership.heartbeat(step, host=host)
+            except Exception as e:  # noqa: BLE001 - store weather must not kill the run
+                self._membership_degraded("heartbeat", e)
+                break
+
+    def _membership_probe(self) -> Optional[int]:
+        """Ask the failure detector for a named lost host this boundary can
+        act on. Suspicions the survivor mesh cannot absorb are skipped (the
+        detector keeps returning them, so a later boundary — e.g. after a
+        regrow — can still act). Store failures degrade to 'no detection
+        this boundary', never to a crashed run."""
+        try:
+            suspicions = self.membership.detect()
+        except Exception as e:  # noqa: BLE001 - store weather must not kill the run
+            self._membership_degraded("detect", e)
+            return None
+        for suspicion in suspicions:
+            if self._loss_valid(suspicion["host"]):
+                logger.warning(
+                    f"elastic: membership detector named host "
+                    f"{suspicion['host']} lost ({suspicion['reason']}, "
+                    f"mttd {suspicion['mttd_s']:.3f}s)"
+                )
+                return suspicion["host"]
+        return None
+
+    def _detect_loss(self, membership_due: bool = False) -> Optional[int]:
         plan = getattr(getattr(self.accelerator, "resilience", None), "chaos", None)
         requested, self._shrink_requested = self._shrink_requested, False
         lost = None
@@ -471,19 +641,27 @@ class ElasticCoordinator:
                 # supervisor-signalled: the plan carries which host (the
                 # probe); fire it regardless of the scheduled step
                 lost = plan.host_loss(plan.host_loss_step, valid=self._loss_valid)
+        if lost is None and self.membership is not None and (membership_due or requested):
+            # a supervisor request always probes (step() ran the boundary
+            # publish for it too) — the throttle paces only the background
+            # cadence, never an explicit signal
+            lost = self._membership_probe()
         if lost is None and requested:
             # a shrink was requested but nothing can name the lost host —
             # swallowing the signal silently would leave the run stepping
-            # toward a hung collective with no explanation. Today the chaos
-            # plan is the only host probe (a real pod additionally needs the
-            # multi-controller re-rendezvous — ROADMAP: multi-slice
-            # elasticity); say so where the operator will look.
+            # toward a hung collective with no explanation. The membership
+            # probe is the production answer (the supervisor publishes the
+            # dead index into its store, and the detector names silent or
+            # wedged hosts on its own); the chaos plan remains the drill
+            # probe. Say so where the operator will look.
             logger.warning(
                 "elastic: shrink requested (supervisor signal) but no host "
-                "probe identified the lost host — no FaultPlan with "
-                "host_loss_step is armed. The run continues on the FULL mesh; "
-                "if a host is really gone, the next collective will hang. "
-                "Arm ACCELERATE_CHAOS_HOST_LOSS_STEP/_INDEX (drills) or call "
+                "probe identified the lost host. The run continues on the "
+                "FULL mesh; if a host is really gone, the next collective "
+                "will hang. Wire a membership= probe (pod-launch --elastic "
+                "--membership_dir, or elastic_coordinator(..., "
+                "membership=MembershipService(...))), arm "
+                "ACCELERATE_CHAOS_HOST_LOSS_STEP/_INDEX (drills), or call "
                 "coordinator.reshard(lost_host=...) directly."
             )
             telemetry = getattr(self.accelerator, "telemetry", None)
@@ -694,6 +872,17 @@ class ElasticCoordinator:
             "resumed_at_step": self.completed_steps,
             "mttr_s": round(mttr, 4),
         }
+        if self.membership is not None:
+            # membership transition: mint the next epoch WITHOUT the lost
+            # host — from here its writes are fenced out as stale. Store
+            # weather here must NOT unwind a recovery that already
+            # succeeded in memory: degrade, and mint at the next transition
+            try:
+                report["epoch"] = self.membership.resolve_loss(
+                    lost_host, reason=f"recovered_{rung}"
+                )
+            except Exception as e:  # noqa: BLE001 - see _membership_degraded
+                self._membership_degraded("resolve_loss", e)
         if gate is not None:
             report["contract_gate"] = gate
         if telemetry is not None:
@@ -745,7 +934,10 @@ class ElasticCoordinator:
                 f"internal: {len(devices)} devices cannot form a training "
                 "mesh (feasibility must be checked before the ladder runs)"
             )
-        state._partial.rebuild_mesh(devices=devices, parallelism=new_par)
+        # the rejoin seam: a pure rebuild_mesh under the single controller;
+        # on a real multi-controller pod the env-gated path re-initializes
+        # jax.distributed over the new member set first (state.py)
+        state._partial.rejoin(devices=devices, parallelism=new_par)
         # ZeRO eligibility changes with the mesh (data=1 after a shrink has
         # nothing to shard over); keep the accelerator's resolution honest
         from ..parallel.zero import zero_eligible
@@ -918,6 +1110,16 @@ class ElasticCoordinator:
             "resumed_at_step": self.completed_steps,
             "mttr_s": round(time.perf_counter() - t0, 4),
         }
+        if self.membership is not None:
+            # re-admission: one epoch mint per revived host (clears its join
+            # record; the host's next heartbeat adopts the new epoch). Store
+            # weather degrades — the regrown mesh is already live, and an
+            # unadmitted join record re-lists at the next boundary
+            for host in sorted(revive):
+                try:
+                    report["epoch"] = self.membership.admit(host)
+                except Exception as e:  # noqa: BLE001 - see _membership_degraded
+                    self._membership_degraded("admit", e)
         if gate is not None:
             report["contract_gate"] = gate
         telemetry = getattr(self.accelerator, "telemetry", None)

@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from ..resilience.detector import SilenceDetector
+
 
 class ReplicaLost(RuntimeError):
     """A replica died (step exception, chaos kill, or heartbeat silence)
@@ -83,7 +85,11 @@ class HealthPolicy:
 
     ``heartbeat_timeout_s=None`` disables the wall-clock probe (an in-process
     fleet steps synchronously, so genuine silence only happens under chaos
-    injection or a wedged XLA call reported by the step watchdog)."""
+    injection or a wedged XLA call reported by the step watchdog). The
+    timeout semantics are the shared
+    :class:`~..resilience.detector.SilenceDetector` — the SAME primitive the
+    training membership service uses, so the two subsystems cannot drift on
+    what "silent" means."""
 
     heartbeat_timeout_s: Optional[float] = None
     # degradation events (watchdog trips + slot quarantines, observed via
@@ -181,15 +187,14 @@ class EngineReplica:
         """Liveness probe. False means operationally dead: chaos took the
         heartbeat, or the engine has work but made no step progress within
         the timeout (a wedged replica and a dead one are indistinguishable
-        from outside — both fail over)."""
+        from outside — both fail over). The silence decision is the shared
+        :class:`~..resilience.detector.SilenceDetector`, one timeout
+        semantic for the fleet and the training membership detector."""
         if self.heartbeat_lost:
             return False
-        timeout = self.policy.heartbeat_timeout_s
-        if (
-            timeout is not None
-            and self.engine.busy
-            and time.monotonic() - self.last_progress > timeout
-        ):
+        if self.engine.busy and SilenceDetector(
+            self.policy.heartbeat_timeout_s
+        ).expired(self.last_progress):
             return False
         return True
 

@@ -160,6 +160,52 @@ class PartialState:
         )
         return self.mesh
 
+    def rejoin(
+        self,
+        devices: Optional[list] = None,
+        parallelism: Optional[ParallelismConfig] = None,
+    ) -> jax.sharding.Mesh:
+        """The elastic re-rendezvous seam (resilience/membership.py): rebuild
+        the topology over the CURRENT member set after a membership
+        transition — a shrink onto the survivors, or a regrow re-admitting a
+        revived host picked up from its join record.
+
+        Under the single controller (every tier-1 drill) the device set is
+        still owned by this process, so rejoin is a pure
+        :meth:`rebuild_mesh` — the simulation boundary, stated honestly.
+
+        On a real multi-controller pod the surviving *processes* must
+        re-rendezvous before any in-process reshard can run: every survivor
+        tears down and re-initializes ``jax.distributed`` over the new
+        member set at the same step boundary (the membership epoch is the
+        agreement on WHO). That call is env-gated behind
+        ``ACCELERATE_ELASTIC_REAL_REJOIN=1`` because on 0.4.37-era runtimes
+        a shutdown+initialize cycle is only supported on real TPU backends
+        — the CPU simulation must never attempt it — and it carries a
+        CONTRACT: the launcher/supervisor must refresh the coordinate env
+        vars (``get_multihost_env``: coordinator address, num_processes,
+        process_id) to the SURVIVOR set before the boundary, because the
+        original values still count the dead host and an argless
+        re-initialize would barrier-wait on a process that will never
+        arrive. Explicit env coordinates are passed through when present;
+        validating this path on hardware is the ROADMAP's multi-slice
+        remainder. See docs/resilience.md § Failure detection & membership.
+        """
+        if parse_flag_from_env("ACCELERATE_ELASTIC_REAL_REJOIN"):
+            kwargs: dict[str, Any] = dict(_init_timeout_kwargs())
+            env = get_multihost_env()
+            if env["coordinator_address"] and env["num_processes"]:
+                # launcher-refreshed survivor coordinates (see contract
+                # above); without them jax re-reads the pod metadata
+                kwargs.update(
+                    coordinator_address=env["coordinator_address"],
+                    num_processes=env["num_processes"],
+                    process_id=env["process_id"],
+                )
+            jax.distributed.shutdown()
+            jax.distributed.initialize(**kwargs)
+        return self.rebuild_mesh(devices=devices, parallelism=parallelism)
+
     # -- topology properties ----------------------------------------------
 
     @property

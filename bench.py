@@ -1507,6 +1507,141 @@ def bench_elastic() -> dict:
     }
 
 
+def bench_membership() -> dict:
+    """Failure detection & membership (resilience/membership.py):
+
+    - **MTTD** — a chaos heartbeat-silent host (NO FaultPlan host probe)
+      must be *named* by the membership detector: ``membership_mttd_s`` is
+      the measured detection latency (silence onset → named suspicion),
+      the metric next to PR 12's MTTR. Dominated by the detector timeout
+      by construction — the bench pins that the machinery adds only
+      boundary-probe overhead on top.
+    - **false positives** — ``membership_false_positive_count`` over an
+      N-step clean window with the detector armed at tier-1 timeouts must
+      be 0 (a detector that cries wolf turns every straggler into a
+      reshard).
+    - **the zombie fence** — the "dead" host resuming with its superseded
+      epoch is rejected (``membership_stale_epoch_write_rejected``), and
+      re-admission through a join record mints a monotonically higher
+      epoch.
+
+    Detector timeouts size from env (``BENCH_MEMBERSHIP_TIMEOUT_S``) so the
+    section fits the tier-1 runtime budget at CPU scale and stays honest at
+    pod scale.
+    """
+    import tempfile
+
+    import jax
+    import optax
+
+    from accelerate_tpu import (
+        Accelerator,
+        ElasticConfig,
+        FaultPlan,
+        FilesystemStore,
+        MembershipConfig,
+        MembershipService,
+        ResilienceConfig,
+    )
+    from accelerate_tpu.models import Bert
+    from accelerate_tpu.utils.random import set_seed
+
+    name = os.environ.get("BENCH_MEMBERSHIP_MODEL", "bert-tiny")
+    timeout_s = float(os.environ.get("BENCH_MEMBERSHIP_TIMEOUT_S", "0.15"))
+    clean_steps = int(os.environ.get("BENCH_MEMBERSHIP_CLEAN_STEPS", "8"))
+    silence_boundary = 4
+
+    def make_batch(model):
+        rng = np.random.default_rng(0)
+        return {
+            "input_ids": np.asarray(
+                rng.integers(0, model.config.vocab_size, (8, 32)), np.int32
+            ),
+            "attention_mask": np.ones((8, 32), np.int32),
+            "labels": np.asarray(rng.integers(0, 2, (8,)), np.int32),
+        }
+
+    def build(store_dir, fault_plan=None):
+        _reset_state()
+        set_seed(0)
+        accelerator = Accelerator(
+            resilience_config=(
+                ResilienceConfig(guard=None, fault_plan=fault_plan)
+                if fault_plan is not None
+                else None
+            ),
+        )
+        model = Bert(name)
+        accelerator.prepare_model(model)
+        accelerator.prepare_optimizer(optax.adamw(1e-3))
+        membership = MembershipService(
+            FilesystemStore(store_dir),
+            num_hosts=2,
+            config=MembershipConfig(
+                heartbeat_timeout_s=timeout_s,
+                stall_timeout_s=timeout_s,
+                stall_steps_behind=2,
+            ),
+        )
+        coordinator = accelerator.elastic_coordinator(
+            Bert.loss_fn(model),
+            config=ElasticConfig(redundancy=1, num_hosts=2),
+            membership=membership,
+        )
+        return model, coordinator, membership
+
+    # -- clean window: armed detector, zero suspicions ------------------------
+    model, coordinator, membership = build(tempfile.mkdtemp(prefix="bench_member_clean_"))
+    batch = make_batch(model)
+    for _ in range(clean_steps):
+        coordinator.step(batch)
+    false_positives = sum(
+        1 for e in membership.events if e["event"] == "host_suspected"
+    )
+
+    # -- the silence drill: detector names the host, ladder recovers ----------
+    plan = FaultPlan(
+        membership_silence_step=silence_boundary, membership_silence_index=1
+    )
+    store_dir = tempfile.mkdtemp(prefix="bench_member_drill_")
+    model, coordinator, membership = build(store_dir, fault_plan=plan)
+    batch = make_batch(model)
+    zombie = MembershipService(FilesystemStore(store_dir), num_hosts=2, host_index=1)
+    for _ in range(silence_boundary - 1):
+        coordinator.step(batch)
+    time.sleep(timeout_s * 1.5)  # the silence must exceed the detector timeout
+    coordinator.step(batch)  # boundary: named + recovered
+    recovery = coordinator.last_recovery or {}
+    suspicion = next(
+        (e for e in membership.events if e["event"] == "host_suspected"), {}
+    )
+
+    # -- the zombie fence + re-admission --------------------------------------
+    stale_rejected = not zombie.heartbeat(99) and zombie.stale_writes_rejected == 1
+    zombie.announce_join()
+    coordinator.step(batch)  # boundary picks up the join → regrow + admit
+    regrown = next(
+        (r for r in coordinator.recoveries if r["event"] == "regrown"), {}
+    )
+
+    return {
+        "membership_model": name,
+        "membership_heartbeat_timeout_s": timeout_s,
+        "membership_clean_window_steps": clean_steps,
+        # over the armed clean window the detector must name NOBODY
+        "membership_false_positive_count": false_positives,
+        "membership_detect_reason": suspicion.get("reason"),
+        "membership_mttd_s": suspicion.get("mttd_s"),
+        "membership_drill_rung": recovery.get("rung"),
+        "membership_drill_host": recovery.get("host"),
+        "membership_drill_mttr_s": recovery.get("mttr_s"),
+        "membership_epoch_after_loss": recovery.get("epoch"),
+        "membership_stale_epoch_write_rejected": bool(stale_rejected),
+        "membership_epoch_after_rejoin": regrown.get("epoch"),
+        "membership_rejoined_mesh": regrown.get("mesh"),
+    }
+
+
 def bench_observability() -> dict:
     """Request-tracing subsystem cost (accelerate_tpu/telemetry/tracing.py):
 
@@ -1871,6 +2006,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "elastic":
         print(json.dumps(bench_elastic()))
         return
+    if os.environ.get("BENCH_ONLY") == "membership":
+        print(json.dumps(bench_membership()))
+        return
 
     device0 = jax.devices()[0]
     on_tpu = device0.platform == "tpu"
@@ -1916,6 +2054,7 @@ def main() -> None:
         ("analysis", bench_analysis, ()),
         ("observability", bench_observability, ()),
         ("elastic", bench_elastic, ()),
+        ("membership", bench_membership, ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport

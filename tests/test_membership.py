@@ -1,0 +1,976 @@
+"""Failure detection & membership (resilience/membership.py; ISSUE 14).
+
+The claims this file pins, each as a measured property rather than prose:
+
+- **The drill** (acceptance) — a chaos heartbeat-SILENT host is *named* by
+  the membership detector (no FaultPlan host probe configured at all), the
+  elastic ladder runs to buddy recovery bit-equal the checkpoint-rung
+  reference, a stale-epoch write from the "dead" host is rejected and
+  recorded, and the revived host re-admits through a join record into a
+  bit-exact ``regrow()`` — with ``{"kind": "membership"}`` records
+  (including ``mttd_s``) in telemetry.jsonl.
+- **The detector** — silence, step-stamp stall (wedged-in-a-collective),
+  supervisor publication, and the self-reported hang each name the right
+  host with the right reason, and a clean window names nobody (no false
+  positives). Timeout semantics are the SAME :class:`SilenceDetector` the
+  serving fleet's replica heartbeat rides (pinned on both consumers).
+- **Epoch fencing** — every membership transition mints a monotonically
+  increasing epoch; a zombie's write from a superseded epoch is refused
+  (``StaleEpochError``), while a fenced-out host that was since re-admitted
+  adopts the new epoch transparently.
+- **The store** — filesystem backend round-trips atomically, and store I/O
+  flake (the chaos ``io_failures`` leg aimed at ``membership_store``) is
+  ridden out by the jittered ``STORE_RETRY`` policy.
+- **Satellites** — ``request_shrink()`` resolves through the membership
+  probe (and the no-probe warning now points at ``membership=``); the
+  chaos env vars parse; ``handle_signals=True`` off the main thread
+  degrades to a warning instead of refusing to construct;
+  ``PartialState.rejoin()`` is a pure mesh rebuild under the single
+  controller.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import (
+    Accelerator,
+    ElasticConfig,
+    FaultPlan,
+    FilesystemStore,
+    MembershipConfig,
+    MembershipService,
+    ResilienceConfig,
+    StaleEpochError,
+    TelemetryConfig,
+)
+from accelerate_tpu.models import Bert
+from accelerate_tpu.resilience import RetryPolicy, SilenceDetector
+from accelerate_tpu.resilience.membership import (
+    EPOCH_KEY,
+    publish_supervisor_loss,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils.random import set_seed
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _bert_batch(model, n=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": np.asarray(
+            rng.integers(0, model.config.vocab_size, (n, seq)), np.int32
+        ),
+        "attention_mask": np.ones((n, seq), np.int32),
+        "labels": np.asarray(rng.integers(0, 2, (n,)), np.int32),
+    }
+
+
+def _tree_equal(a, b) -> bool:
+    return all(jax.tree.leaves(jax.tree.map(np.array_equal, a, b)))
+
+
+def _gather(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def _build(fault_plan=None, telemetry_dir=None, seed=0):
+    _reset()
+    set_seed(seed)
+    accelerator = Accelerator(
+        resilience_config=(
+            ResilienceConfig(guard=None, fault_plan=fault_plan)
+            if fault_plan is not None
+            else None
+        ),
+        telemetry_config=TelemetryConfig(dir=telemetry_dir) if telemetry_dir else None,
+    )
+    model = Bert("bert-tiny")
+    prepared = accelerator.prepare_model(model)
+    optimizer = accelerator.prepare_optimizer(optax.adamw(1e-3))
+    return accelerator, model, prepared, optimizer
+
+
+def _records(telemetry_dir, kind):
+    path = os.path.join(telemetry_dir, "telemetry.jsonl")
+    with open(path) as f:
+        return [r for r in map(json.loads, f) if r.get("kind") == kind]
+
+
+# ---------------------------------------------------------------------------
+# the shared silence primitive (fleet heartbeat + membership, one semantic)
+# ---------------------------------------------------------------------------
+
+
+def test_silence_detector_shared_semantics():
+    """Strictly-greater-than-timeout, None disables — the ONE semantic both
+    the serving fleet heartbeat and the membership detector ride."""
+    detector = SilenceDetector(timeout_s=1.0)
+    assert not detector.expired(last_seen=10.0, now=11.0)  # exactly timeout: alive
+    assert detector.expired(last_seen=10.0, now=11.001)
+    assert detector.silent_for(10.0, now=11.5) == pytest.approx(1.5)
+    assert not SilenceDetector(timeout_s=None).expired(last_seen=0.0, now=1e9)
+
+
+def test_fleet_heartbeat_rides_shared_detector():
+    """The serving replica probe consumes SilenceDetector (no drift): a busy
+    replica is dead strictly past the timeout, an idle one never is."""
+    from accelerate_tpu.serving.fleet import EngineReplica, HealthPolicy
+
+    class _Engine:
+        busy = True
+
+        class stats:
+            watchdog_trips = 0
+            slot_quarantines = 0
+
+    replica = EngineReplica(0, _Engine(), policy=HealthPolicy(heartbeat_timeout_s=0.05))
+    assert replica.heartbeat()
+    replica.last_progress = time.monotonic() - 0.2
+    assert not replica.heartbeat()
+    _Engine.busy = False  # idle replicas are merely idle, never silent
+    assert replica.heartbeat()
+
+
+# ---------------------------------------------------------------------------
+# store: atomic round-trip + flake ridden out by the retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_filesystem_store_roundtrip(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    assert store.read("hosts/0") is None
+    store.write("hosts/0", {"host": 0, "beat": 1})
+    assert store.read("hosts/0") == {"host": 0, "beat": 1}
+    store.write("hosts/1", {"host": 1, "beat": 2})
+    listed = store.list("hosts")
+    assert set(listed) == {"hosts/0", "hosts/1"}
+    store.delete("hosts/0")
+    assert store.read("hosts/0") is None
+    store.delete("hosts/0")  # idempotent
+    # a torn record reads as absent, never as fabricated state
+    (tmp_path / "hosts" / "2.json").write_text('{"host": 2, "bea')
+    assert store.read("hosts/2") is None
+
+
+def test_store_io_flake_ridden_out_by_retry(tmp_path):
+    """The chaos ``io_failures`` leg aimed at ``membership_store``: injected
+    transient EIOs are absorbed by the store's jittered retry policy — the
+    write lands, and the chaos ledger shows the faults really fired."""
+    from accelerate_tpu.resilience import chaos as chaos_mod
+
+    plan = chaos_mod.activate(FaultPlan(io_failures=2))
+    try:
+        store = FilesystemStore(
+            str(tmp_path),
+            retry_policy=RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0),
+        )
+        store.write("hosts/0", {"host": 0})
+        assert store.read("hosts/0") == {"host": 0}
+        assert sum(1 for e in plan.events if e["fault"] == "io_error") == 2
+    finally:
+        chaos_mod.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# the failure detector: silence / step-stall / supervisor / hang, no FPs
+# ---------------------------------------------------------------------------
+
+
+def _service(tmp_path, sub="store", **config):
+    defaults = dict(heartbeat_timeout_s=0.5, stall_steps_behind=2, stall_timeout_s=0.5)
+    defaults.update(config)
+    return MembershipService(
+        FilesystemStore(str(tmp_path / sub)),
+        num_hosts=2,
+        config=MembershipConfig(**defaults),
+    )
+
+
+def test_detector_names_silent_host(tmp_path):
+    svc = _service(tmp_path)
+    t0 = time.time()
+    svc.heartbeat(1, host=0, now=t0)
+    svc.heartbeat(1, host=1, now=t0)
+    # host 1 goes silent; host 0 keeps beating
+    svc.heartbeat(4, host=0, now=t0 + 1.0)
+    detections = svc.detect(now=t0 + 1.0)
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "heartbeat_silence"
+    assert detections[0]["mttd_s"] == pytest.approx(1.0, abs=0.01)
+    # detection repeats until resolved (a boundary that couldn't act may act
+    # later), but the telemetry/ledger entry lands once
+    assert [d["host"] for d in svc.detect(now=t0 + 1.1)] == [1]
+    assert sum(1 for e in svc.events if e["event"] == "host_suspected") == 1
+
+
+def test_detector_names_step_stalled_host(tmp_path):
+    """Beats keep flowing but the step-stamp froze while peers advanced:
+    a rank wedged in a collective — named by the stall leg, not silence."""
+    svc = _service(tmp_path, heartbeat_timeout_s=30.0)
+    t0 = time.time()
+    svc.heartbeat(1, host=0, now=t0)
+    svc.heartbeat(1, host=1, now=t0)
+    svc.heartbeat(4, host=0, now=t0 + 1.0)  # peer advanced 3 steps
+    svc.heartbeat(1, host=1, now=t0 + 1.0)  # alive, step frozen since t0
+    detections = svc.detect(now=t0 + 1.0)
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "step_stall"
+    assert detections[0]["steps_behind"] == 3
+    assert detections[0]["mttd_s"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_detector_clean_window_no_false_positives(tmp_path):
+    """Hosts beating and advancing together are never suspected — the
+    false-positive count the bench gates at 0."""
+    svc = _service(tmp_path, heartbeat_timeout_s=0.2, stall_timeout_s=0.2)
+    t0 = time.time()
+    for step in range(1, 9):
+        for host in (0, 1):
+            svc.heartbeat(step, host=host, now=t0 + 0.1 * step)
+        assert svc.detect(now=t0 + 0.1 * step) == []
+    assert not any(e["event"] == "host_suspected" for e in svc.events)
+
+
+def test_supervisor_published_loss_is_named(tmp_path):
+    """pod-launch --elastic's store publication: the supervisor knew who
+    died; the detector surfaces it with zero inference."""
+    svc = _service(tmp_path)
+    t0 = time.time()
+    svc.heartbeat(1, host=0, now=t0)
+    svc.heartbeat(1, host=1, now=t0)
+    publish_supervisor_loss(svc.store, 1, "exit code 9")
+    detections = svc.detect(now=t0 + 0.01)
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "supervisor"
+    assert detections[0]["mttd_s"] >= 0.0
+    # resolving the loss clears the publication and fences the epoch
+    epoch = svc.resolve_loss(1, reason="supervisor")
+    assert epoch == 2
+    assert svc.store.read("lost/1") is None
+    assert svc.detect(now=t0 + 0.02) == []
+
+
+def test_self_reported_hang_flag_surfaces_to_peers(tmp_path):
+    """The CollectiveHangWatchdog escalation: a wedged host's stall flag is
+    a named suspicion for PEERS, never self-conviction."""
+    store_dir = tmp_path / "hang"
+    wedged = MembershipService(FilesystemStore(str(store_dir)), num_hosts=2, host_index=1)
+    peer = MembershipService(FilesystemStore(str(store_dir)), num_hosts=2, host_index=0)
+    t0 = time.time()
+    for host in (0, 1):
+        peer.heartbeat(1, host=host, now=t0)
+    wedged.report_self_stall(2.5)
+    assert any(e["event"] == "collective_hang_suspected" for e in wedged.events)
+    # the wedged host does not convict itself off its own flag
+    assert wedged.detect(now=t0 + 0.01) == []
+    detections = peer.detect(now=t0 + 0.01)
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "collective_hang"
+    assert detections[0]["hang_s"] == 2.5
+
+
+def test_hang_watchdog_trips_on_blocked_step_and_retracts_on_completion(tmp_path):
+    """The StepWatchdog seam does the reporting: a step blocked past the
+    deadline is reported from the side thread WHILE the host thread is
+    stuck — and when the step then completes after all (slow, not dead),
+    the disarm RETRACTS the flag so peers don't reshard out a healthy
+    host. A true hang never reaches disarm, so a real wedge keeps its flag."""
+    from accelerate_tpu.resilience import CollectiveHangWatchdog
+
+    svc = _service(tmp_path)
+    watchdog = CollectiveHangWatchdog(svc, timeout_s=0.05)
+    try:
+        watchdog.arm()
+        time.sleep(0.3)  # the "wedged collective"
+        # mid-wedge: the flag is up, peers can see it
+        assert svc.store.read("stall/0") is not None
+        watchdog.disarm()  # the step completed: slow, not dead
+    finally:
+        watchdog.close()
+    assert watchdog.trips == 1
+    assert svc.store.read("stall/0") is None  # retracted
+    assert any(e["event"] == "collective_hang_suspected" for e in svc.events)
+    assert any(e["event"] == "collective_hang_cleared" for e in svc.events)
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: zombies rejected, returnees adopt, epochs monotone
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_fencing_rejects_zombie_write(tmp_path):
+    store_dir = str(tmp_path / "fence")
+    survivor = MembershipService(FilesystemStore(store_dir), num_hosts=2, host_index=0)
+    zombie = MembershipService(FilesystemStore(store_dir), num_hosts=2, host_index=1)
+    assert survivor.epoch == 1 and zombie.epoch == 1
+    assert zombie.heartbeat(3)
+    survivor.resolve_loss(1)
+    assert survivor.epoch == 2
+    # the zombie resumes after its stall: its write carries epoch 1 against
+    # a view at epoch 2 with it fenced OUT — refused, recorded, no state
+    assert not zombie.heartbeat(4)
+    assert zombie.stale_writes_rejected == 1
+    assert any(e["event"] == "stale_epoch_write_rejected" for e in zombie.events)
+    assert zombie.epoch == 1  # it did NOT silently adopt the new epoch
+    # the raw store API raises the typed error
+    with pytest.raises(StaleEpochError, match="epoch 1"):
+        zombie.store.fenced_write("hosts/1", {"host": 1}, epoch=1)
+    # re-admission: join → admit → the returnee's next beat adopts epoch 3
+    zombie.announce_join()
+    assert survivor.pending_joins() == [1]
+    assert survivor.admit(1) == 3
+    assert zombie.heartbeat(4)
+    assert zombie.epoch == 3
+    assert any(e["event"] == "epoch_adopted" for e in zombie.events)
+
+
+def test_epoch_mint_refuses_concurrent_transition(tmp_path):
+    """Two survivors racing to resolve the same loss: exactly one mint wins
+    (the CAS shape a GCS/etcd backend makes transactional)."""
+    store_dir = str(tmp_path / "race")
+    a = MembershipService(FilesystemStore(store_dir), num_hosts=3, host_index=0)
+    b = MembershipService(FilesystemStore(store_dir), num_hosts=3, host_index=1)
+    a.resolve_loss(2)
+    with pytest.raises(StaleEpochError):
+        b.store.mint_epoch({"epoch": 2, "members": [0, 1]}, expected=1)
+    view = a.view()
+    assert view["epoch"] == 2 and view["members"] == [0, 1]
+
+
+def test_resolve_loss_race_loser_adopts_winners_epoch(tmp_path):
+    """Every survivor independently detects the same loss and resolves it:
+    exactly one mint wins, and the LOSERS adopt the winner's epoch instead
+    of erroring out of an otherwise-successful recovery."""
+    store_dir = str(tmp_path / "race2")
+    a = MembershipService(FilesystemStore(store_dir), num_hosts=3, host_index=0)
+    b = MembershipService(FilesystemStore(store_dir), num_hosts=3, host_index=1)
+    assert a.resolve_loss(2) == 2
+    # b raced and lost (its view was epoch 1 when the loss happened): the
+    # host is already gone, so b adopts epoch 2 — no raise, no double mint
+    assert b.resolve_loss(2) == 2
+    assert b.epoch == 2
+    assert a.view()["epoch"] == 2  # not minted twice
+    assert any(e["event"] == "epoch_adopted" for e in b.events)
+    # same shape for admit: a admits the returnee, b's admit adopts
+    a.announce_join(2)
+    assert a.admit(2) == 3
+    assert b.admit(2) == 3
+    assert b.epoch == 3
+    assert a.view()["members"] == [0, 1, 2]
+
+
+def test_member_without_heartbeat_record_is_silent_from_epoch_mint(tmp_path):
+    """A host admitted (its stale heartbeat record deliberately cleared)
+    that dies before its FIRST beat must not be invisible: silence anchors
+    on the epoch mint time."""
+    svc = _service(tmp_path)  # heartbeat_timeout_s=0.5
+    t0 = time.time()
+    svc.heartbeat(1, host=0, now=t0)
+    svc.heartbeat(1, host=1, now=t0)
+    svc.resolve_loss(1)
+    svc.announce_join(1)
+    svc.admit(1)  # deletes hosts/1 — and host 1 dies before re-beating
+    mint_time = svc.view()["minted_at"]
+    svc.heartbeat(2, host=0, now=mint_time + 1.0)
+    assert svc.detect(now=mint_time + 0.1) == []  # inside the mint grace
+    detections = svc.detect(now=mint_time + 1.0)
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "heartbeat_silence"
+    assert detections[0]["never_beat"] is True
+    assert detections[0]["mttd_s"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_multi_sequential_losses_epochs_increase_monotonically(tmp_path):
+    """Loss after loss after re-admission: every transition mints the next
+    epoch, strictly increasing — the property the zombie fence stands on."""
+    svc = MembershipService(FilesystemStore(str(tmp_path / "seq")), num_hosts=4)
+    epochs = [svc.epoch]
+    epochs.append(svc.resolve_loss(3))
+    epochs.append(svc.resolve_loss(1))
+    svc.announce_join(3)
+    epochs.append(svc.admit(3))
+    epochs.append(svc.resolve_loss(2))
+    assert epochs == [1, 2, 3, 4, 5]
+    assert svc.view()["members"] == [0, 3]
+    minted = [e for e in svc.events if e["event"] == "epoch_minted"]
+    assert [e["epoch"] for e in minted] == [2, 3, 5]  # admit records host_admitted
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: silent host NAMED (no FaultPlan host probe),
+# ladder → buddy bit-equal the checkpoint reference, zombie fenced,
+# join-record re-admission → bit-exact regrow
+# ---------------------------------------------------------------------------
+
+
+def _membership_coordinator(tmp_path, sub, fault_plan=None, redundancy=1, **svc_kwargs):
+    tdir = str(tmp_path / f"telemetry_{sub}")
+    accelerator, model, prepared, optimizer = _build(
+        fault_plan=fault_plan, telemetry_dir=tdir
+    )
+    membership = MembershipService(
+        FilesystemStore(str(tmp_path / f"store_{sub}")),
+        num_hosts=2,
+        config=MembershipConfig(
+            heartbeat_timeout_s=0.1, stall_steps_behind=2, stall_timeout_s=0.1
+        ),
+        **svc_kwargs,
+    )
+    coordinator = accelerator.elastic_coordinator(
+        Bert.loss_fn(model),
+        config=ElasticConfig(redundancy=redundancy, num_hosts=2),
+        membership=membership,
+    )
+    return accelerator, model, prepared, optimizer, coordinator, tdir
+
+
+def test_membership_drill_silent_host_named_recovers_readmits(tmp_path):
+    # --- the drill: NO host_loss probe anywhere — the chaos leg only
+    # silences host 1's heartbeat publisher from boundary 4 on; the
+    # membership detector must do the naming
+    plan = FaultPlan(membership_silence_step=4, membership_silence_index=1)
+    assert plan.host_loss_step is None
+    accelerator, model, prepared, optimizer, coordinator, tdir = _membership_coordinator(
+        tmp_path, "drill", fault_plan=plan
+    )
+    membership = coordinator.membership
+    batch = _bert_batch(model)
+    losses = []
+    for _ in range(3):
+        losses.append(float(coordinator.step(batch)))
+    # host 1's publisher is now dead; give the silence time to exceed the
+    # detector timeout, then the next boundary must name it and recover
+    time.sleep(0.15)
+    zombie = MembershipService(
+        FilesystemStore(str(tmp_path / "store_drill")), num_hosts=2, host_index=1
+    )
+    assert zombie.epoch == 1
+    for _ in range(3):
+        losses.append(float(coordinator.step(batch)))
+    assert coordinator.last_recovery["event"] == "recovered"
+    assert coordinator.last_recovery["rung"] == "buddy"
+    assert coordinator.last_recovery["host"] == 1
+    assert coordinator.last_recovery["steps_lost"] == 0
+    assert coordinator.last_recovery["epoch"] == 2
+    assert dict(coordinator.mesh.shape)["data"] == 4
+
+    # --- bit-equal the checkpoint-rung reference on the same shrunken mesh
+    # (the PR 12 reference pattern: chaos host_loss at the same boundary,
+    # redundancy=0, checkpoint saved AT the boundary)
+    ckpt_dir = str(tmp_path / "ref_ckpt")
+    ref_plan = FaultPlan(host_loss_step=4, host_loss_index=1)
+    acc_b, model_b, prep_b, opt_b = _build(
+        fault_plan=ref_plan, telemetry_dir=str(tmp_path / "telemetry_ref")
+    )
+    coord_b = acc_b.elastic_coordinator(
+        Bert.loss_fn(model_b),
+        config=ElasticConfig(redundancy=0, num_hosts=2, checkpoint_dir=ckpt_dir),
+    )
+    batch_b = _bert_batch(model_b)
+    losses_b = []
+    for i in range(6):
+        if coord_b.completed_steps == 3:
+            acc_b.save_state(
+                os.path.join(ckpt_dir, "checkpoint_3"), manifest_metadata={"step": 3}
+            )
+        losses_b.append(float(coord_b.step(batch_b)))
+    assert coord_b.last_recovery["rung"] == "checkpoint"
+    assert _tree_equal(_gather(prepared.params), _gather(prep_b.params))
+    assert _tree_equal(_gather(optimizer.opt_state), _gather(opt_b.opt_state))
+    np.testing.assert_array_equal(losses, losses_b)
+
+    # --- the zombie: host 1 "comes back" holding the superseded epoch — its
+    # write is rejected and recorded, never landed
+    assert not zombie.heartbeat(99)
+    assert zombie.stale_writes_rejected == 1
+
+    # --- re-admission: join record → survivors pick it up at the next step
+    # boundary and turn it into regrow(), bit-exact
+    zombie.announce_join()
+    losses.append(float(coordinator.step(batch)))  # boundary admits + regrows
+    assert dict(coordinator.mesh.shape)["data"] == 8
+    assert coordinator.lost_hosts == set()
+    regrown = [r for r in coordinator.recoveries if r["event"] == "regrown"]
+    assert len(regrown) == 1 and regrown[0]["hosts"] == [1]
+    assert regrown[0]["epoch"] == 3
+    assert membership.view()["members"] == [0, 1]
+    # (regrow bit-exactness is pinned without a step in between by
+    # test_membership_regrow_is_bit_exact_relayout)
+    assert zombie.heartbeat(coordinator.completed_steps)  # re-adopts epoch 3
+    assert zombie.epoch == 3
+
+    # --- observability: membership records with mttd_s in telemetry.jsonl
+    records = _records(tdir, "membership")
+    events = [r["event"] for r in records]
+    assert "host_suspected" in events
+    suspected = next(r for r in records if r["event"] == "host_suspected")
+    assert suspected["host"] == 1
+    assert suspected["reason"] == "heartbeat_silence"
+    assert suspected["mttd_s"] > 0.1  # at least the detector timeout
+    minted = [r for r in records if r["event"] == "epoch_minted"]
+    assert [r["epoch"] for r in minted] == [2]
+    assert "host_admitted" in events
+    # the elastic recovery record carries the epoch it minted
+    recovered = [
+        r for r in _records(tdir, "elastic") if r["event"] == "recovered"
+    ]
+    assert len(recovered) == 1 and recovered[0]["epoch"] == 2
+    # the chaos ledger agrees the silence (and nothing else) fired
+    faults = [e["fault"] for e in accelerator.resilience.chaos.events]
+    assert faults == ["membership_silence"]
+
+
+def test_membership_regrow_is_bit_exact_relayout(tmp_path):
+    """The regrow-through-join path is a pure relayout: params/opt state
+    gathered before the shrink, after the shrink, and after the join-driven
+    regrow are all bit-identical when no step runs in between."""
+    accelerator, model, prepared, optimizer, coordinator, _ = _membership_coordinator(
+        tmp_path, "relayout"
+    )
+    batch = _bert_batch(model)
+    for _ in range(2):
+        coordinator.step(batch)
+    reference = _gather(prepared.params)
+    reference_opt = _gather(optimizer.opt_state)
+    coordinator.reshard(lost_host=1)
+    assert coordinator.membership.epoch == 2
+    assert _tree_equal(reference, _gather(prepared.params))
+    assert _tree_equal(reference_opt, _gather(optimizer.opt_state))
+    # the revived host announces; the coordinator picks the join up at the
+    # boundary WITHOUT stepping first (regrow precedes the step)
+    joiner = MembershipService(
+        FilesystemStore(str(tmp_path / "store_relayout")), num_hosts=2, host_index=1
+    )
+    joiner.announce_join()
+    assert coordinator.membership.pending_joins() == [1]
+    coordinator._membership_boundary()  # what step() runs first at a boundary
+    assert dict(coordinator.mesh.shape)["data"] == 8
+    assert coordinator.membership.epoch == 3
+    assert _tree_equal(reference, _gather(prepared.params))
+    assert _tree_equal(reference_opt, _gather(optimizer.opt_state))
+    coordinator.step(batch)  # and the regrown mesh trains
+
+
+def test_step_stall_straggler_drives_ladder(tmp_path):
+    """The wedged-rank drill end to end: host 1 keeps heartbeating but its
+    step-stamp freezes (chaos membership_stall); peers advance; the
+    detector names it via the stall leg and the ladder recovers."""
+    plan = FaultPlan(membership_stall_step=2, membership_stall_index=1)
+    accelerator, model, prepared, optimizer, coordinator, tdir = _membership_coordinator(
+        tmp_path, "stall", fault_plan=plan
+    )
+    batch = _bert_batch(model)
+    for _ in range(3):
+        coordinator.step(batch)
+    assert coordinator.last_recovery is None  # not enough peer progress yet
+    time.sleep(0.15)  # stall_timeout_s=0.1 since the stamp last advanced
+    coordinator.step(batch)
+    assert coordinator.last_recovery is not None
+    assert coordinator.last_recovery["host"] == 1
+    assert coordinator.last_recovery["rung"] == "buddy"
+    suspected = next(
+        r for r in _records(tdir, "membership") if r["event"] == "host_suspected"
+    )
+    assert suspected["reason"] == "step_stall"
+    assert suspected["mttd_s"] > 0.1
+    faults = [e["fault"] for e in accelerator.resilience.chaos.events]
+    assert faults == ["membership_stall"]
+
+
+# ---------------------------------------------------------------------------
+# satellites: request_shrink via membership, env vars, signal-thread degrade,
+# rejoin seam, coordinator validation
+# ---------------------------------------------------------------------------
+
+
+def test_request_shrink_resolves_via_membership_probe(tmp_path):
+    """Satellite branch A: a supervisor-published loss + SIGUSR1-style
+    request_shrink() resolves to a NAMED reshard — no chaos host probe, no
+    warning."""
+    accelerator, model, prepared, optimizer, coordinator, tdir = _membership_coordinator(
+        tmp_path, "resolve"
+    )
+    batch = _bert_batch(model)
+    coordinator.step(batch)
+    publish_supervisor_loss(coordinator.membership.store, 1, "exit code 3")
+    coordinator.request_shrink()
+    coordinator.step(batch)
+    assert coordinator.last_recovery["event"] == "recovered"
+    assert coordinator.last_recovery["host"] == 1
+    assert dict(coordinator.mesh.shape)["data"] == 4
+    assert not any(
+        r["event"] == "shrink_request_unresolved" for r in _records(tdir, "elastic")
+    )
+    suspected = next(
+        r for r in _records(tdir, "membership") if r["event"] == "host_suspected"
+    )
+    assert suspected["reason"] == "supervisor"
+
+
+def test_request_shrink_without_probe_warning_points_at_membership(tmp_path, caplog):
+    """Satellite branch B: with NO membership probe the PR 12 warning +
+    record are kept — and the warning now tells the operator about
+    membership=."""
+    import logging
+
+    tdir = str(tmp_path / "telemetry")
+    accelerator, model, prepared, optimizer = _build(telemetry_dir=tdir)
+    coordinator = accelerator.elastic_coordinator(
+        Bert.loss_fn(model), config=ElasticConfig(redundancy=0, num_hosts=2)
+    )
+    assert coordinator.membership is None
+    batch = _bert_batch(model)
+    coordinator.step(batch)
+    coordinator.request_shrink()
+    with caplog.at_level(logging.WARNING):
+        coordinator.step(batch)
+    warning = next(r.message for r in caplog.records if "no host probe" in r.message)
+    assert "membership=" in warning
+    assert any(
+        r["event"] == "shrink_request_unresolved" for r in _records(tdir, "elastic")
+    )
+    assert dict(coordinator.mesh.shape)["data"] == 8  # run continues, full mesh
+
+
+def test_membership_chaos_env_vars(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_STEP", "4")
+    monkeypatch.setenv("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_INDEX", "1")
+    monkeypatch.setenv("ACCELERATE_CHAOS_MEMBERSHIP_STALL_STEP", "6")
+    monkeypatch.setenv("ACCELERATE_CHAOS_MEMBERSHIP_STALL_INDEX", "2")
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.active
+    # silence is PERSISTENT from the armed boundary (a dead publisher never
+    # beats again), recorded once
+    assert not plan.membership_silent(1, 3)
+    assert not plan.membership_silent(0, 4)
+    assert plan.membership_silent(1, 4)
+    assert plan.membership_silent(1, 7)
+    assert sum(1 for e in plan.events if e["fault"] == "membership_silence") == 1
+    # the stall publishes the frozen pre-wedge step-stamp
+    assert plan.membership_stall(2, 5) is None
+    assert plan.membership_stall(2, 6) == 5
+    assert plan.membership_stall(2, 9) == 5
+    assert sum(1 for e in plan.events if e["fault"] == "membership_stall") == 1
+
+
+def test_handle_signals_off_main_thread_degrades_to_warning(tmp_path, caplog):
+    """Satellite: a library-embedded coordinator (constructed off the main
+    thread) cannot install the SIGUSR1 handler — it must still construct,
+    warning once, with the handler flagged unarmed."""
+    import logging
+
+    accelerator, model, prepared, optimizer = _build(
+        telemetry_dir=str(tmp_path / "telemetry")
+    )
+    result = {}
+
+    def construct():
+        with caplog.at_level(logging.WARNING):
+            result["coordinator"] = accelerator.elastic_coordinator(
+                Bert.loss_fn(model),
+                config=ElasticConfig(redundancy=0, num_hosts=2, handle_signals=True),
+            )
+
+    thread = threading.Thread(target=construct)
+    thread.start()
+    thread.join()
+    coordinator = result["coordinator"]  # constructed, no raise
+    assert not coordinator.signals_armed
+    assert any("UNARMED" in r.message for r in caplog.records)
+    # the manual path still works
+    coordinator.request_shrink()
+    assert coordinator._shrink_requested
+    # and ON the main thread the handler arms
+    accelerator2, model2, _, _ = _build(telemetry_dir=str(tmp_path / "t2"))
+    armed = accelerator2.elastic_coordinator(
+        Bert.loss_fn(model2),
+        config=ElasticConfig(redundancy=0, num_hosts=2, handle_signals=True),
+    )
+    assert armed.signals_armed
+
+
+def test_late_watchdog_trip_after_disarm_is_suppressed(tmp_path):
+    """The disarm/trip race: a watchdog thread firing AFTER the step
+    completed (disarm already ran) must not publish an orphaned stall flag
+    nobody will ever retract — peers would reshard out a healthy host."""
+    from accelerate_tpu.resilience import CollectiveHangWatchdog
+
+    svc = _service(tmp_path)
+    watchdog = CollectiveHangWatchdog(svc, timeout_s=60.0)  # will not trip on its own
+    try:
+        watchdog.arm()
+        watchdog.disarm()
+        # the preempted thread fires late, after disarm
+        watchdog._on_hang(0.5)
+    finally:
+        watchdog.close()
+    assert watchdog.trips == 0
+    assert svc.store.read("stall/0") is None
+    assert not any(e["event"] == "collective_hang_suspected" for e in svc.events)
+
+
+def test_host_index_out_of_range_raises():
+    """Clamping would alias several processes onto one membership identity
+    (their interleaved beats mask a real death) — reject loudly instead."""
+    import tempfile
+
+    with pytest.raises(ValueError, match="host_index"):
+        MembershipService(
+            FilesystemStore(tempfile.mkdtemp()), num_hosts=2, host_index=2
+        )
+
+
+def test_store_outage_degrades_boundary_instead_of_killing_run(tmp_path, caplog):
+    """Store weather outlasting STORE_RETRY must not crash the training run
+    the membership service exists to protect: the boundary's membership
+    work degrades to a warning + record and the step still executes."""
+    import logging
+
+    accelerator, model, prepared, optimizer, coordinator, tdir = _membership_coordinator(
+        tmp_path, "outage"
+    )
+    batch = _bert_batch(model)
+    coordinator.step(batch)
+    broken = coordinator.membership.store
+
+    def _raise(*args, **kwargs):
+        raise OSError(5, "mount gone")
+
+    for op in ("read", "write", "list", "delete"):
+        setattr(broken, op, _raise)
+    with caplog.at_level(logging.WARNING):
+        loss = float(coordinator.step(batch))  # survives the outage
+    assert np.isfinite(loss)
+    assert coordinator.completed_steps == 2
+    assert any("degraded" in r.message for r in caplog.records)
+    assert any(e["event"] == "store_degraded" for e in coordinator.membership.events)
+
+
+def test_min_probe_interval_throttles_store_io_but_not_requests(tmp_path):
+    """Per-boundary store I/O is throttled by min_probe_interval_s (a pod
+    with sub-second steps must not fsync per step) — while an explicit
+    request_shrink() probes immediately regardless."""
+    accelerator, model, prepared, optimizer = _build(
+        telemetry_dir=str(tmp_path / "telemetry")
+    )
+    store = FilesystemStore(str(tmp_path / "store"))
+    membership = MembershipService(
+        store,
+        num_hosts=2,
+        config=MembershipConfig(heartbeat_timeout_s=86400.0, min_probe_interval_s=3600.0),
+    )
+    coordinator = accelerator.elastic_coordinator(
+        Bert.loss_fn(model),
+        config=ElasticConfig(redundancy=1, num_hosts=2),
+        membership=membership,
+    )
+    batch = _bert_batch(model)
+    for _ in range(3):
+        coordinator.step(batch)
+    # the first boundary beat; the next two were inside the interval
+    assert store.read("hosts/0")["beat"] == 1
+    # an explicit supervisor signal probes NOW despite the throttle — and
+    # runs the full boundary (fresh beats published) before detecting
+    publish_supervisor_loss(store, 1, "exit code 9")
+    coordinator.request_shrink()
+    coordinator.step(batch)
+    assert coordinator.last_recovery is not None
+    assert coordinator.last_recovery["host"] == 1
+    assert store.read("hosts/0")["beat"] == 2  # the requested boundary beat
+
+
+def test_probe_interval_must_sit_under_heartbeat_timeout():
+    """An interval at or past the timeout would read healthy peers (whose
+    beats age up to one interval between probes) as silent — rejected at
+    config time."""
+    with pytest.raises(ValueError, match="min_probe_interval_s"):
+        MembershipConfig(heartbeat_timeout_s=30.0, min_probe_interval_s=30.0)
+    MembershipConfig(heartbeat_timeout_s=30.0, min_probe_interval_s=7.5)  # fine
+    # None disables the silence leg entirely — no false-positive hazard for
+    # the throttle to guard against, so the combination is legal
+    MembershipConfig(heartbeat_timeout_s=None, min_probe_interval_s=5.0)
+
+
+def test_multi_process_coordinator_publishes_only_its_own_heartbeat(tmp_path):
+    """On a real pod every process must publish ONLY its own beat: peers
+    refreshing a dead host's record would blind the silence detector. The
+    sim flag (process_count==1) is what enables publish-for-all."""
+    accelerator, model, prepared, optimizer, coordinator, _ = _membership_coordinator(
+        tmp_path, "ownbeat"
+    )
+    assert coordinator._sim_publish  # single controller: simulate all hosts
+    store = coordinator.membership.store
+    batch = _bert_batch(model)
+    coordinator.step(batch)
+    assert store.read("hosts/0") is not None and store.read("hosts/1") is not None
+    # flip to the real-pod publishing discipline: only host_index beats
+    coordinator._sim_publish = False
+    store.delete("hosts/1")
+    coordinator.step(batch)
+    assert store.read("hosts/0")["beat"] == 2
+    assert store.read("hosts/1") is None  # nobody resurrects the peer's record
+
+
+def test_resolve_loss_store_outage_degrades_not_unwinds_recovery(tmp_path):
+    """Store weather at the epoch mint — the moment right AFTER a
+    successful in-memory recovery — must degrade, never crash the job the
+    ladder just saved."""
+    accelerator, model, prepared, optimizer, coordinator, _ = _membership_coordinator(
+        tmp_path, "mintfail"
+    )
+    batch = _bert_batch(model)
+    for _ in range(2):
+        coordinator.step(batch)
+    membership = coordinator.membership
+
+    def _raise(*args, **kwargs):
+        raise OSError(5, "mount gone")
+
+    membership.store.write = _raise  # the mint's write path
+    report = coordinator.reshard(lost_host=1)  # recovery itself succeeds
+    assert report["rung"] == "buddy"
+    assert "epoch" not in report  # honestly absent, not fabricated
+    assert dict(coordinator.mesh.shape)["data"] == 4
+    assert any(e["event"] == "store_degraded" for e in membership.events)
+
+
+def test_stale_join_records_resolve_instead_of_looping(tmp_path):
+    """A join record the coordinator cannot regrow (host never lost from
+    ITS mesh) must not re-list forever: a moot record (already a member) is
+    deleted, a genuinely fenced-out joiner is admitted at the membership
+    level."""
+    accelerator, model, prepared, optimizer, coordinator, _ = _membership_coordinator(
+        tmp_path, "stalejoin"
+    )
+    membership = coordinator.membership
+    batch = _bert_batch(model)
+    # moot join: host 1 is a live member and was never lost
+    membership.announce_join(1)
+    coordinator.step(batch)
+    assert membership.pending_joins() == []
+    assert membership.view()["members"] == [0, 1]
+    # fenced-out joiner with no coordinator memory of the loss (restart
+    # scenario): membership resolved it out, lost_hosts is empty
+    membership.resolve_loss(1, reason="pre_restart")
+    epoch_before = membership.epoch
+    joiner = MembershipService(
+        FilesystemStore(str(tmp_path / "store_stalejoin")), num_hosts=2, host_index=1
+    )
+    joiner.announce_join()
+    coordinator.step(batch)  # admits at the membership level, no regrow needed
+    assert membership.pending_joins() == []
+    assert membership.view()["members"] == [0, 1]
+    assert membership.epoch == epoch_before + 1
+
+
+def test_membership_from_env_wires_unmodified_coordinator(tmp_path, monkeypatch):
+    """The pod-launch transport: ACCELERATE_MEMBERSHIP_DIR alone gives an
+    unmodified training script's coordinator a live membership probe —
+    supervisor publications resolve without any code change."""
+    store_dir = str(tmp_path / "env_store")
+    monkeypatch.setenv("ACCELERATE_MEMBERSHIP_DIR", store_dir)
+    accelerator, model, prepared, optimizer = _build(
+        telemetry_dir=str(tmp_path / "telemetry")
+    )
+    coordinator = accelerator.elastic_coordinator(
+        Bert.loss_fn(model), config=ElasticConfig(redundancy=1, num_hosts=2)
+    )
+    assert coordinator.membership is not None
+    assert isinstance(coordinator.membership.store, FilesystemStore)
+    assert coordinator.membership.store.root == store_dir
+    batch = _bert_batch(model)
+    coordinator.step(batch)
+    publish_supervisor_loss(store_dir, 1, "silent for 30s")
+    coordinator.request_shrink()  # the SIGUSR1 the supervisor sent
+    coordinator.step(batch)
+    assert coordinator.last_recovery["host"] == 1
+    assert coordinator.last_recovery["rung"] == "buddy"
+
+
+def test_rejoin_seam_is_pure_rebuild_under_single_controller():
+    """PartialState.rejoin without ACCELERATE_ELASTIC_REAL_REJOIN is exactly
+    rebuild_mesh — the simulation boundary, pinned (the real-pod
+    jax.distributed path is env-gated and documented, not reachable on
+    CPU)."""
+    import dataclasses as dc
+
+    _reset()
+    accelerator = Accelerator()
+    state = accelerator.state._partial
+    devices = list(state.mesh.devices.reshape(-1))[:4]
+    par = dc.replace(state.parallelism, data=4)
+    mesh = state.rejoin(devices=devices, parallelism=par)
+    assert mesh is state.mesh
+    assert mesh.devices.size == 4
+    full = state.rejoin(
+        devices=list(jax.devices()), parallelism=dc.replace(par, data=8)
+    )
+    assert full.devices.size == 8
+
+
+def test_coordinator_rejects_mismatched_membership_view(tmp_path):
+    """A membership service tracking a different host count than the
+    coordinator simulates would name different hosts for the same rank —
+    refused at construction."""
+    accelerator, model, prepared, optimizer = _build(
+        telemetry_dir=str(tmp_path / "telemetry")
+    )
+    membership = MembershipService(
+        FilesystemStore(str(tmp_path / "store")), num_hosts=4
+    )
+    with pytest.raises(ValueError, match="4 hosts"):
+        accelerator.elastic_coordinator(
+            Bert.loss_fn(model),
+            config=ElasticConfig(redundancy=0, num_hosts=2),
+            membership=membership,
+        )
+
+
+def test_coordinator_hang_watchdog_reports_wedged_step(tmp_path):
+    """The coordinator arms the hang watchdog around the compiled step: a
+    step blocked past the deadline is reported from the side (record + store
+    stall flag) while the run eventually completes."""
+    accelerator, model, prepared, optimizer = _build(
+        telemetry_dir=str(tmp_path / "telemetry_hang")
+    )
+    membership = MembershipService(
+        FilesystemStore(str(tmp_path / "store_hang")),
+        num_hosts=2,
+        config=MembershipConfig(
+            heartbeat_timeout_s=30.0, hang_watchdog_timeout_s=0.05
+        ),
+    )
+    coordinator = accelerator.elastic_coordinator(
+        Bert.loss_fn(model),
+        config=ElasticConfig(redundancy=0, num_hosts=2),
+        membership=membership,
+    )
+    assert coordinator._hang_watchdog is not None
+    real_step = coordinator._step
+
+    def slow_step(batch):
+        time.sleep(0.3)  # the wedge
+        return real_step(batch)
+
+    coordinator._step = slow_step
+    coordinator.step(_bert_batch(model))
+    assert coordinator._hang_watchdog.trips == 1
+    # the step COMPLETED, so the flag was retracted on disarm — a slow step
+    # must not leave the host permanently convicted
+    assert membership.store.read("stall/0") is None
+    records = _records(str(tmp_path / "telemetry_hang"), "membership")
+    assert any(r["event"] == "collective_hang_suspected" for r in records)
+    assert any(r["event"] == "collective_hang_cleared" for r in records)

@@ -350,6 +350,107 @@ def test_elastic_double_loss_shrinks_twice():
     assert len(procs[0].signals) == 2  # notified for both losses
 
 
+def test_elastic_supervisor_publishes_lost_index_to_store(tmp_path):
+    """ISSUE 14 satellite: the supervisor KNOWS which worker died — with a
+    membership_dir it publishes the index into the rendezvous store before
+    signalling, and a survivor-side MembershipService resolves the loss to
+    a NAMED host (the pod-launch → store → coordinator path)."""
+    import signal
+
+    from accelerate_tpu.resilience import FilesystemStore, MembershipService
+
+    store_dir = str(tmp_path / "membership")
+    procs = [
+        _ElasticProc([None, None, None, None, 0]),
+        _ElasticProc([3]),
+    ]
+    rc = supervise(
+        lambda i: procs[i], 2, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+        membership_dir=store_dir,
+    )
+    assert rc == 0
+    assert procs[0].signals == [signal.SIGUSR1]
+    store = FilesystemStore(store_dir)
+    record = store.read("lost/1")
+    assert record is not None
+    assert record["source"] == "supervisor"
+    assert "exit code 3" in record["reason"]
+    # the survivor's detector turns the publication into a named suspicion
+    survivor = MembershipService(store, num_hosts=2, host_index=0)
+    detections = survivor.detect()
+    assert [d["host"] for d in detections] == [1]
+    assert detections[0]["reason"] == "supervisor"
+    assert detections[0]["mttd_s"] >= 0.0
+
+
+def test_elastic_multi_sequential_losses_publish_each_and_epochs_increase(tmp_path):
+    """Two separate worker deaths publish two lost records; the survivor
+    resolving each mints monotonically increasing epochs — the
+    multi-sequential-loss drill."""
+    from accelerate_tpu.resilience import FilesystemStore, MembershipService
+
+    store_dir = str(tmp_path / "membership")
+    procs = [
+        _ElasticProc([None] * 8 + [0]),
+        _ElasticProc([2]),
+        _ElasticProc([None, None, 4]),
+    ]
+    rc = supervise(
+        lambda i: procs[i], 3, poll_interval=0.01,
+        restart_policy=_NO_BACKOFF, partial_failure="elastic",
+        membership_dir=store_dir,
+    )
+    assert rc == 0
+    store = FilesystemStore(store_dir)
+    assert store.read("lost/1") is not None
+    assert store.read("lost/2") is not None
+    survivor = MembershipService(store, num_hosts=3, host_index=0)
+    epochs = [survivor.epoch]
+    for detection in survivor.detect():
+        epochs.append(survivor.resolve_loss(detection["host"], reason="supervisor"))
+    assert epochs == [1, 2, 3]  # strictly monotone, one mint per loss
+    assert survivor.view()["members"] == [0]
+    assert survivor.detect() == []  # both publications consumed
+
+
+def test_membership_dir_exported_to_workers():
+    """The store path reaches the training side: assemble_worker_command
+    exports ACCELERATE_MEMBERSHIP_DIR so an unmodified script's
+    ElasticCoordinator finds the store via MembershipService.from_env."""
+    import argparse
+
+    from accelerate_tpu.commands.pod import assemble_worker_command
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, membership_dir="/mnt/gcs/membership",
+        training_script="train.py", training_script_args=[],
+    )
+    command = assemble_worker_command(args)
+    assert "export ACCELERATE_MEMBERSHIP_DIR=/mnt/gcs/membership" in command
+    # and without the flag nothing leaks
+    args.membership_dir = None
+    assert "MEMBERSHIP" not in assemble_worker_command(args)
+
+
+def test_cli_membership_dir_requires_elastic():
+    import argparse
+
+    from accelerate_tpu.commands.pod import run
+
+    args = argparse.Namespace(
+        tpu_name="pod", tpu_zone="z", use_alpha=False, use_sudo=False,
+        worker="all", env=[], workdir=None, debug=True, mixed_precision=None,
+        num_processes=None, num_workers=2, restart_on_failure=0,
+        heartbeat_timeout=0.0, elastic=False, membership_dir="/tmp/m",
+        training_script="train.py", training_script_args=[],
+    )
+    with pytest.raises(ValueError, match="elastic"):
+        run(args)
+
+
 def test_supervise_rejects_unknown_partial_failure_mode():
     with pytest.raises(ValueError, match="partial_failure"):
         supervise(lambda i: _FakeProc(0), 1, partial_failure="nope")
