@@ -1322,6 +1322,20 @@ class Accelerator:
                 guarded_step_impl if res_on else step_impl, donate_argnums=donate_argnums
             )
 
+        if self.telemetry.enabled:
+            # {"kind": "kernels"} at step build (the serving engine writes the
+            # same kind at its first step): names whether the fused adamw
+            # kernel (ops/fused_adamw.py) is in this step's update — a fleet
+            # operator greps one record kind for kernel coverage everywhere
+            self.telemetry.write_record(
+                "kernels",
+                {
+                    "program": "train_step",
+                    "fused_adamw": "pallas" if getattr(tx, "fused_apply", None) else None,
+                    "zero_update_sharding": self._zero_update_sharding,
+                },
+            )
+
         def lower(batch):
             """AOT-lower the fused program against the LIVE params/opt_state —
             the program-audit entry point (``Accelerator.analyze``): traces

@@ -323,8 +323,17 @@ class QuantizedLayerPacker:
         f = _bitcast_u8(u8[self.q_total :], jnp.float32)
         return self.unpack((q, f))
 
-    def unpack(self, bufs) -> dict:
-        from .utils.quantization import dequantize_weight
+    def unpack(self, bufs, quantized_resident: bool = False) -> dict:
+        """Unpack one layer. ``quantized_resident=True`` (the kernel-layer
+        serving path, ops/quant_matmul.py) keeps 2-D matrix leaves PACKED as
+        :class:`~.utils.quantization.QuantizedWeight` instead of
+        dequantizing — the fused dequant-matmul then reads them 1
+        byte/element and the bf16 shadow never exists. Non-matrix leaves
+        and >2-D leaves (MoE expert stacks, consumed by einsum rather than
+        the ``dot_fn`` hook) dequantize exactly as before. The buffer
+        layout is sliced in ONE place for both modes, so the packed path
+        can never drift from the shadowed one."""
+        from .utils.quantization import QuantizedWeight, dequantize_weight
 
         qbuf, fbuf = bufs
         out = {}
@@ -335,7 +344,10 @@ class QuantizedLayerPacker:
             q = qbuf[offset : offset + size].reshape(stored_shape)
             f_off, f_size = self.f_offsets[f"{key}@scale"]
             scale = fbuf[f_off : f_off + f_size]
-            out[key] = dequantize_weight(q, scale, self.bits, self.dtype)
+            if quantized_resident and len(shape) == 2:
+                out[key] = QuantizedWeight(q, scale, self.bits, self.dtype)
+            else:
+                out[key] = dequantize_weight(q, scale, self.bits, self.dtype)
         for key in self.full_keys:
             offset, size = self.f_offsets[key]
             out[key] = fbuf[offset : offset + size].reshape(self.shapes[key]).astype(self.dtype)

@@ -229,6 +229,35 @@ def _self_check(compile: bool):
     engine = ServingEngine(llama, lparams, tracer=RequestTracer(), **engine_kwargs)
     reports.append(engine.analyze(compile=compile, write_record=False))
 
+    # the KERNEL-enabled decode program (ops/paged_attention.py) is a
+    # different program — Pallas page-walk attention instead of the gather —
+    # with its own contract (`serving_decode_kernels`): page tables must
+    # still ride as arguments (no baked constants) and donation must hold
+    # with the kernel in the graph. Prefill programs are identical under
+    # kernels (the kernel is decode-only), so only the decode is re-audited.
+    kernel_engine = ServingEngine(llama, lparams, use_kernels=True, **engine_kwargs)
+    if kernel_engine._use_decode_kernel:
+        reports.append(
+            kernel_engine.analyze(
+                compile=compile, include_prefill=False, write_record=False
+            )
+        )
+    else:
+        from ..ops.runtime import interpret_mode
+
+        if interpret_mode():
+            # in the contract-recording environment (interpret mode) the
+            # kernel engine MUST engage — a silent fallback here would drop
+            # serving_decode_kernels from gating while the gate still exits
+            # 0 (gate_reports only flags report-without-contract, never
+            # contract-without-report). On assert-Mosaic/TPU runs the tiny
+            # self-check geometry legitimately falls back and the contract's
+            # env check skips it honestly.
+            raise RuntimeError(
+                "self-check kernel engine failed to engage the paged decode "
+                f"kernel: {kernel_engine._kernel_fallback_reason}"
+            )
+
     # the routed decode path: replication must not change the program, so a
     # 2-replica fleet's per-replica audits must come back exactly as clean
     # (donation intact on EVERY replica) as the lone engine's above — the

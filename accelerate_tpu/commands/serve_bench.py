@@ -113,6 +113,13 @@ def register_subcommand(subparsers):
         "(the comparison baseline)",
     )
     parser.add_argument(
+        "--no-kernels", action="store_true",
+        help="Disable the Pallas kernel layer (paged decode attention + "
+        "fused dequant-matmul; docs/performance.md) — the gather/dequant "
+        "reference programs, mirroring --no-paged as the A/B baseline. "
+        "Default: kernels ON (interpret mode off-TPU)",
+    )
+    parser.add_argument(
         "--trace", action="store_true",
         help="Request-scoped tracing: spans (queued/prefill/parked/handoff/"
         "decode) for every request land in telemetry.jsonl and export to "
@@ -186,6 +193,7 @@ def run(args) -> int:
         params = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
         )
+    use_kernels = not args.no_kernels
     if args.int8:
         from ..big_modeling import dispatch_model, make_layered_device_map
         from ..serving import params_from_streamed
@@ -195,7 +203,15 @@ def run(args) -> int:
             model, params, make_layered_device_map(model, "cpu"),
             dtype=params["embed_tokens"].dtype, quantization=QuantizationConfig(load_in_8bit=True),
         )
-        params = params_from_streamed(streamed)
+        packed = None
+        if use_kernels:
+            # kernel layer: matrix weights stay PACKED on device and the
+            # fused dequant-matmul reads them 1 byte/element — no bf16
+            # shadow. One install policy, shared with from_streamed.
+            from ..serving import quantized_resident_params
+
+            packed = quantized_resident_params(streamed)
+        params = packed if packed is not None else params_from_streamed(streamed)
 
     if args.mixed or args.shared_prefix:
         prompts = make_mixed_prompts(
@@ -248,6 +264,7 @@ def run(args) -> int:
             eos_token_id=args.eos_token_id, temperature=args.temperature,
             paged=not args.no_paged, page_size=args.page_size,
             prefill_chunk=args.prefill_chunk, tracer=tracer,
+            use_kernels=use_kernels,
         )
         # the hub attaches AFTER construction (exactly like the router wires
         # replicas): a hub passed to the constructor would also hand the
@@ -369,6 +386,11 @@ def run(args) -> int:
         "prefill_replicas": args.prefill_replicas if disagg else None,
         "decode_replicas": args.decode_replicas if disagg else None,
         "int8": bool(args.int8),
+        "kernels": (
+            warm_engine.kernel_summary()
+            if hasattr(warm_engine, "kernel_summary")
+            else warm_engine.replicas[0].engine.kernel_summary()
+        ),
         "paged": not args.no_paged,
         "page_size": args.page_size if not args.no_paged else None,
         "prefill_chunk": args.prefill_chunk,
@@ -412,6 +434,14 @@ def run(args) -> int:
         + ")"
         if not args.no_paged
         else "dense slots"
+    )
+    ks = payload["kernels"]
+    layout += (
+        f", kernels(decode={ks['decode_attention']}"
+        + (f", quant={ks['quant_matmul']}" if ks["quant_matmul"] else "")
+        + ")"
+        if use_kernels
+        else ", no kernels"
     )
     scenario = (
         (", mixed long/short" if args.mixed else "")

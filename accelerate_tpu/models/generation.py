@@ -44,18 +44,25 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
     positions = length + jnp.arange(s)[None, :]
     cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
 
-    # positions <= current are attendable: causal within the block, full over cache
-    t = cache["k"].shape[2]
-    query_pos = length + jnp.arange(s)
-    key_pos = jnp.arange(t)
-    mask = (key_pos[None, :] <= query_pos[:, None])[None, None]  # [1,1,S,T]
+    # paged-kernel decode (serving engine, use_kernels=True): the cache's
+    # "k"/"v" are the page POOL (scanned per layer) and "attend" masks inside
+    # the kernel against "table"/"length" — no [S, T] mask to build here
+    extra = {key: cache[key] for key in ("table", "attend") if key in cache}
+    if extra:
+        mask = None
+    else:
+        # positions <= current are attendable: causal within the block, full over cache
+        t = cache["k"].shape[2]
+        query_pos = length + jnp.arange(s)
+        key_pos = jnp.arange(t)
+        mask = (key_pos[None, :] <= query_pos[:, None])[None, None]  # [1,1,S,T]
 
     def body(carry, xs):
         h = carry
         lp, k_cache, v_cache = xs
         h, new_cache = decoder_layer(
             cfg, h, lp, cos, sin, mask,
-            cache={"k": k_cache, "v": v_cache, "length": length},
+            cache={"k": k_cache, "v": v_cache, "length": length, **extra},
             dot_fn=getattr(model, "dot_fn", None),
         )
         return h, (new_cache["k"], new_cache["v"])

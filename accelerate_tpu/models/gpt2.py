@@ -113,7 +113,13 @@ class GPT2:
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(b, s, nh, d) for t in (q, k, v))
         new_cache = None
-        if cache is not None:
+        if cache is not None and "attend" in cache:
+            # paged-kernel decode: attention reads the page pool directly
+            # (ops/paged_attention.py); the engine scatters the returned
+            # new-token K/V — see models/llama.py decoder_layer
+            attn = cache["attend"](q, k, v, cache)
+            new_cache = {"k": k, "v": v}
+        elif cache is not None:
             k_cache = jax.lax.dynamic_update_slice(
                 cache["k"], k.astype(cache["k"].dtype), (0, cache["length"], 0, 0)
             )
@@ -161,11 +167,18 @@ class GPT2:
         decode_suffix, scanned over the stacked layers."""
         b, s = input_ids.shape
         length = cache["length"]
-        carry = self.decode_prefix(params, input_ids, length, max_len=cache["k"].shape[2])
+        # paged-kernel decode threads the pool's table + attend hook through
+        # (see models/llama.py decoder_layer); max_len only shapes the mask,
+        # which the kernel path computes internally from table/length
+        extra = {key: cache[key] for key in ("table", "attend") if key in cache}
+        max_len = self.config.max_seq_len if extra else cache["k"].shape[2]
+        carry = self.decode_prefix(params, input_ids, length, max_len=max_len)
 
         def body(carry, xs):
             lp, k_cache, v_cache = xs
-            carry, nc = self.stream_layer_cached(carry, lp, {"k": k_cache, "v": v_cache}, length)
+            carry, nc = self.stream_layer_cached(
+                carry, lp, {"k": k_cache, "v": v_cache, **extra}, length
+            )
             return carry, (nc["k"], nc["v"])
 
         carry, (k_cache, v_cache) = jax.lax.scan(body, carry, (params["layers"], cache["k"], cache["v"]))
@@ -270,7 +283,7 @@ class GPT2:
 
     def stream_layer_cached(self, carry, lp, cache, length):
         h, mask = carry
-        h, nc = self._block(h, lp, mask, cache={"k": cache["k"], "v": cache["v"], "length": length})
+        h, nc = self._block(h, lp, mask, cache={**cache, "length": length})
         return (h, mask), nc
 
     def decode_suffix(self, resident, carry):

@@ -83,7 +83,15 @@ def decoder_layer(
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
     new_cache = None
-    if cache is not None:
+    if cache is not None and "attend" in cache:
+        # paged-kernel decode (serving engine, use_kernels=True): the cache
+        # carries one layer of the page POOL plus this slot's table row, and
+        # ``attend`` (ops/paged_attention.py) reads the pool directly — no
+        # gathered view, no in-layer cache write. The new token's K/V return
+        # as the cache delta; the engine scatters them into the pool.
+        attn = cache["attend"](q, k, v, cache)
+        new_cache = {"k": k, "v": v, "length": cache["length"]}
+    elif cache is not None:
         k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, cache["length"], 0, 0))
         v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cache["length"], 0, 0))
         attn = dot_product_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask)

@@ -48,23 +48,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .runtime import fit_block
+from .runtime import interpret_mode as _interpret
+from .runtime import sds as _sds
+
+# block sizes adapt downward to divide the sequence; floor 128 = lane width
+_fit_block = functools.partial(fit_block, floor=128)
+
 NEG_INF = -1e30
 # running-max init: far below any real score, far above NEG_INF, so masked
 # scores underflow exp() even when a row never sees a valid key
 M_INIT = NEG_INF / 2
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _sds(shape, dtype, like) -> jax.ShapeDtypeStruct:
-    """Out-shape struct inheriting ``like``'s varying-manual-axes type, so the
-    kernel also runs inside shard_map manual regions (the pipeline schedule)."""
-    vma = getattr(getattr(like, "aval", None), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 class _Cfg(NamedTuple):
@@ -633,12 +627,6 @@ def _lse_bwd_rule(cfg: _Cfg, res, gs):
 _flash_attention_lse_bnsd.defvjp(_lse_fwd_rule, _lse_bwd_rule)
 
 
-def _fit_block(block: int, s: int) -> int:
-    """Adapt a block size DOWNWARD (halving, floor 128) until it divides s."""
-    block = min(block, s)
-    while block > 128 and s % block:
-        block //= 2
-    return block
 
 
 def _mask_limit(kv_mask: jax.Array):

@@ -1,0 +1,216 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The serving engine's reference decode gathers every slot's FULL contiguous
+KV view per step (``ServingEngine._gathered_view``: ``jnp.take`` over the
+page pool, ``[L, view_len, KV, D]`` per slot per layer) before the model's
+einsum attention reads it. The paged layout (PR 7) made HBM *residency*
+proportional to tokens actually held, but the gather still moves — and
+temporarily materializes — ``view_len`` worth of K/V per slot per token,
+regardless of how few positions are valid.
+
+This kernel attends the page pool DIRECTLY: each program owns one
+(slot, kv-head) pair — the slot axis rides in as a vmap-batched grid
+dimension, so one slot-batched launch serves every lane of the decode step —
+walks that slot's int32 page-table row up to its dynamic ``length`` bound,
+DMAs one ``[page_size, D]`` page block at a time from HBM into VMEM, and
+folds it into an online softmax. The gathered view is never materialized,
+invalid pages are never read (a fresh request touches one page, not
+``view_len``), and the current token's K/V — not yet scattered into the
+pool — joins the softmax as a final key, so the engine's write-back stays
+a separate scatter exactly as in the reference program.
+
+Numerics: scores accumulate in fp32 (``preferred_element_type``), the
+running max starts at the flash kernel's ``M_INIT`` so padded tail
+positions of a partial page underflow ``exp`` to exactly 0. The new-token
+score is always valid, so a decode row can never be fully masked. At
+temperature 0 the engine's kernel path emits the same tokens as the
+gather-reference path (pinned by tests/test_paged_attention.py over mixed
+lengths for both decode protocols); the blocked accumulation order means
+logits agree to roundoff, not bit-for-bit.
+
+Off-TPU the kernel runs in interpret mode (tier-1 exercises the page walk
+for real); shapes Mosaic cannot tile (lane-unaligned head dim) fall back to
+a gather reference with identical masking semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import M_INIT, NEG_INF
+from .runtime import interpret_mode
+
+
+def paged_kernel_fallback_reason(
+    page_shape: tuple, num_heads: int, kv_heads: int
+) -> Optional[str]:
+    """Why the paged decode kernel cannot serve this pool geometry (None =
+    it can). Interpret mode runs any shape; Mosaic needs the head dim to
+    fill lanes. The engine records the reason in its ``{"kind":"kernels"}``
+    telemetry so a fleet's kernel coverage is a query away."""
+    ps, d = int(page_shape[-3]), int(page_shape[-1])
+    if num_heads % kv_heads:
+        return f"num_heads {num_heads} not a multiple of kv_heads {kv_heads}"
+    if interpret_mode():
+        return None
+    if d % 128:
+        return f"head dim {d} is not a multiple of 128 (Mosaic lane tiling)"
+    if ps % 8:
+        return f"page_size {ps} is not a multiple of 8 (fp32 sublane tiling)"
+    return None
+
+
+def _decode_kernel(
+    table_ref,  # SMEM [1, pps] int32: this slot's page-table row
+    length_ref,  # SMEM [1, 1] int32: valid positions already in the pool
+    q_ref,  # VMEM [1, group, D]: the q heads sharing this kv head (pre-scaled)
+    kn_ref,  # VMEM [1, D]: current token's key for this kv head
+    vn_ref,  # VMEM [1, D]: current token's value
+    pool_k_ref,  # ANY (HBM) [P, ps, KV, D]
+    pool_v_ref,  # ANY (HBM) [P, ps, KV, D]
+    o_ref,  # VMEM [1, group, D] out
+    k_scratch,  # VMEM [ps, D] pool dtype
+    v_scratch,  # VMEM [ps, D]
+    sems,  # DMA semaphores (2,)
+    *,
+    page_size: int,
+):
+    g = pl.program_id(0)  # kv head (slot axis joins via vmap batching)
+    length = length_ref[0, 0]
+    q = q_ref[0]  # [group, D]
+    group, d = q.shape
+
+    m = jnp.full((group, 1), M_INIT, jnp.float32)
+    l = jnp.zeros((group, 1), jnp.float32)
+    acc = jnp.zeros((group, d), jnp.float32)
+
+    # pages holding positions 0..length-1 (zero-trip for a fresh/idle lane)
+    npages = jax.lax.div(length + jnp.int32(page_size - 1), jnp.int32(page_size))
+    pos_in_page = jax.lax.broadcasted_iota(jnp.int32, (group, page_size), 1)
+
+    def body(j, carry):
+        m, l, acc = carry
+        page = table_ref[0, j]
+        k_dma = pltpu.make_async_copy(pool_k_ref.at[page, :, g, :], k_scratch, sems.at[0])
+        v_dma = pltpu.make_async_copy(pool_v_ref.at[page, :, g, :], v_scratch, sems.at[1])
+        k_dma.start()
+        v_dma.start()
+        k_dma.wait()
+        v_dma.wait()
+        s = jax.lax.dot_general(
+            q, k_scratch[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, ps]
+        # mask the partial last page: positions >= length hold stale pool
+        # data (or the unwritten tail) and must underflow exp to exactly 0
+        s = jnp.where(j * page_size + pos_in_page < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p.astype(v_scratch.dtype), v_scratch[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, npages, body, (m, l, acc))
+
+    # the current token (position == length) is not in the pool yet — it is
+    # the engine's post-step scatter — so it joins as one final key here
+    kn = kn_ref[:]  # [1, D]
+    vn = vn_ref[:]
+    s_new = jax.lax.dot_general(
+        q, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [group, 1]
+    m_new = jnp.maximum(m, s_new)
+    correction = jnp.exp(m - m_new)
+    p_new = jnp.exp(s_new - m_new)
+    l = l * correction + p_new
+    acc = acc * correction + jax.lax.dot_general(
+        p_new.astype(vn.dtype), vn, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _reference(q, k_new, v_new, pool_k, pool_v, table, length, scale):
+    """Gather-based fallback with the kernel's exact masking semantics —
+    attends the table-gathered view plus the new token. Only reached for
+    Mosaic-untileable geometries; the engine's ``use_kernels=False`` path is
+    a different (byte-identical-to-PR-7) program and never lands here."""
+    from ..models.attention import dot_product_attention
+
+    taken_k = jnp.take(pool_k, table, axis=0).reshape(-1, *pool_k.shape[2:])
+    taken_v = jnp.take(pool_v, table, axis=0).reshape(-1, *pool_v.shape[2:])
+    keys = jnp.concatenate([taken_k, k_new[0]], axis=0)[None]  # [1, T+1, KV, D]
+    values = jnp.concatenate([taken_v, v_new[0]], axis=0)[None]
+    t = taken_k.shape[0]
+    valid = jnp.concatenate(
+        [jnp.arange(t) < length, jnp.ones((1,), bool)]
+    )[None, None, None, :]
+    return dot_product_attention(q, keys, values, mask=valid, scale=scale)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [1, 1, NH, D]: one slot's single decode query
+    k_new: jax.Array,  # [1, 1, KV, D]: current token's key (pre-scatter)
+    v_new: jax.Array,  # [1, 1, KV, D]
+    pool_k: jax.Array,  # [P, page_size, KV, D]: one layer of the page pool
+    pool_v: jax.Array,  # [P, page_size, KV, D]
+    table: jax.Array,  # [pps] int32 page-table row
+    length: jax.Array,  # scalar int32: positions already in the pool
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """One decode token's attention over its paged KV — the ``attend`` hook
+    the serving engine threads through the models' decode-cache protocol
+    (``decoder_layer`` / ``GPT2._block``) when ``use_kernels`` is on. The
+    engine's vmap over slots batches the launch, so the compiled program is
+    ONE slot-batched ``pallas_call`` per layer per decode step."""
+    _, _, nh, d = q.shape
+    kv = k_new.shape[2]
+    ps = pool_k.shape[-3]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    if paged_kernel_fallback_reason(pool_k.shape, nh, kv) is not None:
+        return _reference(q, k_new, v_new, pool_k, pool_v, table, length, scale)
+    # the reference einsum path scales q (in q's dtype) before the score
+    # matmul — mirror it so kernel and reference agree to roundoff
+    qs = (q * jnp.asarray(scale, q.dtype))[0, 0]  # [NH, D]
+    group = nh // kv
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=ps),
+        grid=(kv,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # table [1, pps]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # length [1, 1]
+            pl.BlockSpec((1, group, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kv, group, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((ps, d), pool_k.dtype),
+            pltpu.VMEM((ps, d), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret_mode(),
+    )(
+        table.reshape(1, -1).astype(jnp.int32),
+        jnp.asarray(length, jnp.int32).reshape(1, 1),
+        qs.reshape(kv, group, d),
+        k_new[0, 0],
+        v_new[0, 0],
+        pool_k,
+        pool_v,
+    )
+    return out.reshape(1, 1, nh, d)

@@ -39,16 +39,27 @@ def scaled_optimizer_update(tx, params, opt_state, grads, gnorm, scale, growth_t
     is a plain optax update.
 
     Returns ``(params, opt_state, scale, growth_tracker, skipped)``.
+
+    A transform exposing ``fused_apply`` (ops/fused_adamw.py: the Pallas
+    one-read-one-write adamw kernel) updates params and state in ONE fused
+    call instead of ``tx.update`` + ``apply_updates`` — engaged identically
+    on this eager path and inside the ZeRO manual-shard_map step
+    (parallel/zero.py), which calls through here, so the kernel slots in
+    behind the existing tolerance-0 update-equivalence gate.
     """
     import optax
 
+    fused_apply = getattr(tx, "fused_apply", None)
+
+    def do_update(args):
+        params, opt_state, grads = args
+        if fused_apply is not None:
+            return fused_apply(params, opt_state, grads)
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
     if scaler_cfg is not None:
         finite = jnp.isfinite(gnorm)
-
-        def do_update(args):
-            params, opt_state, grads = args
-            updates, new_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_state
 
         params, opt_state = jax.lax.cond(
             finite, do_update, lambda args: (args[0], args[1]), (params, opt_state, grads)
@@ -63,8 +74,7 @@ def scaled_optimizer_update(tx, params, opt_state, grads, gnorm, scale, growth_t
         growth_tracker = jnp.where(grew, 0, growth_tracker)
         skipped = ~finite
     else:
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params, opt_state = do_update((params, opt_state, grads))
         skipped = jnp.asarray(False)
     return params, opt_state, scale, growth_tracker, skipped
 

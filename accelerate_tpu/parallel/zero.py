@@ -251,6 +251,13 @@ def build_zero_step(
 ):
     """The fused ZeRO train-step program.
 
+    The closing ``sharded adamw`` dispatches through
+    ``optimizer.scaled_optimizer_update``: an ``optax.adamw`` lowers to the
+    usual elementwise HLO chain, while ``ops.fused_adamw.fused_adamw``
+    swaps in the Pallas one-read-one-write update kernel (in place via
+    ``input_output_aliases``) — bit-equal at tolerance 0, so the
+    update-equivalence gate below applies to both (tests/test_fused_adamw).
+
     Signature-compatible with ``Accelerator.compiled_step``'s jitted program:
     ``(params, opt_state, batch, scale, growth_tracker)`` — plus
     ``(guard_state, corrupt)`` when ``guard_policy``/``chaos_nan_target`` arm

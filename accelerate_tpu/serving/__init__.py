@@ -16,7 +16,13 @@ chips. Later serving work (speculative decoding, multi-host serve meshes)
 builds on these pieces.
 """
 
-from .engine import ServingEngine, ServingResult, StepWatchdog, params_from_streamed
+from .engine import (
+    ServingEngine,
+    ServingResult,
+    StepWatchdog,
+    params_from_streamed,
+    quantized_resident_params,
+)
 from .fleet import (
     REPLICA_ROLES,
     EngineReplica,
@@ -65,6 +71,7 @@ __all__ = [
     "paged_kv_cache_bytes",
     "pages_for",
     "params_from_streamed",
+    "quantized_resident_params",
     "prefill_buckets",
     "run_offered_load",
 ]

@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import make_sampler, resolve_decode_protocol
+from ..ops.runtime import kernels_default
 from ..telemetry.serving import ServingStats
 from ..utils.jit_cache import dot_keyed_jit
 from .kv_cache import SlotKVCache, bucket_for, prefill_buckets
@@ -114,7 +115,7 @@ def generation_row(
     return row
 
 
-def params_from_streamed(streamed) -> dict:
+def params_from_streamed(streamed, quantized_resident: bool = False) -> dict:
     """Reassemble full device-resident params from a ``StreamedModel``.
 
     This is the int8 serving load path: ``dispatch_model(..., quantization=
@@ -125,17 +126,69 @@ def params_from_streamed(streamed) -> dict:
     resident compute stays in the streamer's dtype (W8A16 semantics, same as
     the streamed path). Works just as well unquantized: any checkpoint the
     big-model loader can place becomes a resident serving param tree.
+
+    ``quantized_resident=True`` (the kernel-layer serving path, docs/
+    performance.md) keeps each quantized MATRIX leaf packed on device as a
+    :class:`~.utils.quantization.QuantizedWeight` instead of dequantizing:
+    the fused dequant-matmul kernel (ops/quant_matmul.py, wired through the
+    models' ``dot_fn`` hook) then reads 1-byte weights straight from HBM and
+    the resident bf16 shadow disappears — serving HBM for weights drops by
+    the quantization ratio, not just host RAM. Non-matrix leaves (norms,
+    biases) and >2-D leaves (MoE expert stacks, consumed by einsum rather
+    than the dot hook) dequantize exactly as before.
     """
-    from ..big_modeling import _device_put_packed
+    from ..big_modeling import QuantizedLayerPacker, _device_put_packed
 
     streamed._before_execute()  # restore() if a pipeline hook evicted it
     params = streamed.resident_tree()
+    packer = streamed.packer
+    keep_packed = quantized_resident and isinstance(packer, QuantizedLayerPacker)
     layers = []
     for i, buf in enumerate(streamed.layer_buffers):
         if not streamed.layer_on_device[i]:
             buf = _device_put_packed(buf)  # int8 packs ride the DMA quantized
-        layers.append(streamed.packer.unpack(buf))  # dequantize on device
+        if keep_packed:
+            layers.append(packer.unpack(buf, quantized_resident=True))
+        else:
+            layers.append(packer.unpack(buf))  # dequantize on device
+    # QuantizedWeight is a pytree node: the stack recurses into (q, scale)
+    # and rebuilds the packed container around the stacked children
     params["layers"] = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    return params
+
+
+def quantized_resident_params(streamed) -> Optional[dict]:
+    """The ONE install policy for fused-dequant serving, shared by
+    :meth:`ServingEngine.from_streamed` and the ``serve-bench`` CLI: when
+    the streamer is quantized and the model exposes the ``dot_fn`` hook,
+    build packed-resident params (``QuantizedWeight`` matrix leaves) and
+    install ``quant_dot`` on the model — returns the params, or None when
+    the streamer/model cannot engage (caller keeps the shadowed path)."""
+    from ..big_modeling import QuantizedLayerPacker
+
+    if not isinstance(streamed.packer, QuantizedLayerPacker):
+        return None
+    if not hasattr(streamed.model, "dot_fn"):
+        return None
+    from ..ops.quant_matmul import quant_dot
+
+    current = streamed.model.dot_fn
+    if current is not None and current is not quant_dot:
+        # another hook already owns the projections (fp8_dot from an fp8
+        # prepare) — silently replacing it would strip that compute from
+        # every later program rebuilt on this model. Keep the shadowed
+        # dequant path and say so.
+        from ..logging import get_logger
+
+        get_logger(__name__).warning(
+            f"quantized-resident serving skipped: model.dot_fn is already "
+            f"{getattr(current, '__name__', current)!r} — refusing to replace "
+            "an installed projection hook; serving from the dequantized "
+            "shadow instead."
+        )
+        return None
+    params = params_from_streamed(streamed, quantized_resident=True)
+    streamed.model.dot_fn = quant_dot
     return params
 
 
@@ -218,6 +271,7 @@ class ServingEngine:
         prefill_chunk: Optional[int] = None,
         prefix_sharing: bool = True,
         prefix_cache_entries: int = 256,
+        use_kernels: Optional[bool] = None,
     ):
         self.model = model
         # ``name`` tags this engine's telemetry records — a routed fleet sets
@@ -255,6 +309,32 @@ class ServingEngine:
                 raise ValueError(f"largest bucket {max(self.buckets)} exceeds max_len {max_len}")
             self.prefill_chunk = None
             self.prefix_sharing = False
+        # -- kernel layer (ops/: docs/performance.md "Kernel layer") --------
+        # None resolves by backend: on for real TPUs (the kernels are the
+        # fast path), off for CPU/GPU meshes so every pre-kernel program —
+        # and the tier-1 suite pinned to it — stays byte-identical. Tests
+        # and serve-bench pass True explicitly to run the interpret-mode
+        # kernels for real.
+        self.use_kernels = kernels_default() if use_kernels is None else bool(use_kernels)
+        self._kernel_fallback_reason: Optional[str] = None
+        self._use_decode_kernel = False
+        if self.use_kernels:
+            if not paged:
+                self._kernel_fallback_reason = "dense slot cache (paged=False)"
+            else:
+                from ..ops.paged_attention import paged_kernel_fallback_reason
+
+                cfg = getattr(model, "config", None)
+                nh = getattr(cfg, "num_heads", None)
+                kv = self.cache.k.shape[-2]
+                if nh is None:
+                    self._kernel_fallback_reason = "model exposes no head-count config"
+                else:
+                    self._kernel_fallback_reason = paged_kernel_fallback_reason(
+                        self.cache.k.shape[1:], nh, kv
+                    )
+            self._use_decode_kernel = self._kernel_fallback_reason is None
+        self._kernels_reported = False  # one {"kind": "kernels"} record per engine
         self.scheduler = ContinuousBatchingScheduler(num_slots, max_queue=max_queue)
         self._pending = np.zeros((num_slots,), np.int32)  # next input token per slot
         self._rng = rng if rng is not None else jax.random.key(0)
@@ -431,18 +511,41 @@ class ServingEngine:
         fwc, sample = self._fwc, self._sample
         ps = self.cache.page_size
         gathered = self._gathered_view
+        use_kernel = self._use_decode_kernel
 
         def build():
             def decode_step(params, pk, pv, tokens, lengths, active, tables, keys):
-                def one_slot(token, row, length, key):
-                    cache = gathered(pk, pv, row, length)
-                    logits, nc = fwc(params, token[None, None], cache)
-                    ok = jnp.all(jnp.isfinite(logits))
-                    # only position `length` changed: extract it for the
-                    # write-back scatter instead of re-scattering the view
-                    wk = jax.lax.dynamic_slice_in_dim(nc["k"][:, 0], length, 1, axis=1)[:, 0]
-                    wv = jax.lax.dynamic_slice_in_dim(nc["v"][:, 0], length, 1, axis=1)[:, 0]
-                    return sample(logits, key)[0], ok, wk, wv
+                if use_kernel:
+                    # the Pallas path (ops/paged_attention.py): attention
+                    # reads the pool + this slot's table row DIRECTLY — the
+                    # gathered view is never materialized, invalid pages are
+                    # never read. The vmap below batches the slot axis into
+                    # the kernel grid, so this stays one slot-batched launch
+                    # per layer per step; the protocol returns the new
+                    # token's K/V as the cache delta, already extracted.
+                    from ..ops.paged_attention import paged_decode_attention
+
+                    def attend(q, kn, vn, c):
+                        return paged_decode_attention(
+                            q, kn, vn, c["k"], c["v"], c["table"], c["length"]
+                        )
+
+                    def one_slot(token, row, length, key):
+                        cache = {"k": pk, "v": pv, "length": length,
+                                 "table": row, "attend": attend}
+                        logits, nc = fwc(params, token[None, None], cache)
+                        ok = jnp.all(jnp.isfinite(logits))
+                        return sample(logits, key)[0], ok, nc["k"][:, 0, 0], nc["v"][:, 0, 0]
+                else:
+                    def one_slot(token, row, length, key):
+                        cache = gathered(pk, pv, row, length)
+                        logits, nc = fwc(params, token[None, None], cache)
+                        ok = jnp.all(jnp.isfinite(logits))
+                        # only position `length` changed: extract it for the
+                        # write-back scatter instead of re-scattering the view
+                        wk = jax.lax.dynamic_slice_in_dim(nc["k"][:, 0], length, 1, axis=1)[:, 0]
+                        wv = jax.lax.dynamic_slice_in_dim(nc["v"][:, 0], length, 1, axis=1)[:, 0]
+                        return sample(logits, key)[0], ok, wk, wv
 
                 nxt, ok, wk, wv = jax.vmap(one_slot)(tokens, tables, lengths, keys)
                 # write-back: active slots append at (table[length // ps],
@@ -464,7 +567,7 @@ class ServingEngine:
 
         return self._jit(
             ("serve_paged_decode", self.cache.num_slots, self.cache.view_len, ps,
-             self.temperature, self._donate),
+             self.temperature, self._donate, use_kernel),
             build,
         )
 
@@ -1285,6 +1388,7 @@ class ServingEngine:
         logits, retire finished requests. Returns the requests that finished
         THIS step (including expired/cancelled ones, with their reason)."""
         t0 = time.perf_counter()
+        self._report_kernels()
         finished: list[ServingResult] = self._retire_degraded(t0)
         self._inject_chaos_burst()
         for slot, request in self.scheduler.admit_ready(self._free_slot):
@@ -1867,10 +1971,14 @@ class ServingEngine:
         ``serving_prefill_<span>``), appending any drift findings."""
         from ..analysis import Finding, audit_lowered
 
+        # the kernel-enabled decode is a DIFFERENT program (Pallas calls,
+        # no gather) with its own checked-in contract — label it apart so
+        # `analyze --self-check` gates both programs independently
+        decode_label = "serving_decode_kernels" if self._use_decode_kernel else "serving_decode"
         report = audit_lowered(
             self._lower_decode(),
             compile=compile,
-            label="serving_decode",
+            label=decode_label,
             expect_donation=self._donate,
             **audit_kwargs,
         )
@@ -1878,10 +1986,10 @@ class ServingEngine:
             report.add(
                 Finding(
                     "DONATION_DISABLED",
-                    f"serving_decode: KV-cache donation is off for backend "
+                    f"{decode_label}: KV-cache donation is off for backend "
                     f"{jax.default_backend()!r} — decode HBM traffic doubles "
                     "vs tpu/gpu",
-                    path="serving_decode",
+                    path=decode_label,
                 )
             )
         if include_prefill:
@@ -1946,6 +2054,61 @@ class ServingEngine:
         out["jit_cache_misses"] = compiles["jit_cache_misses"]
         return out
 
+    def kernel_summary(self) -> dict:
+        """Which ops/ kernels this engine engaged and why any fell back —
+        the payload of the ``{"kind": "kernels"}`` record, also handy for
+        tests and the serve-bench report."""
+        from ..ops.quant_matmul import quant_fallback_reason
+        from ..utils.quantization import QuantizedWeight
+
+        quantized = [
+            leaf for leaf in jax.tree.leaves(
+                self.params, is_leaf=lambda x: isinstance(x, QuantizedWeight)
+            )
+            if isinstance(leaf, QuantizedWeight)
+        ]
+        # the quant kernel gates PER CALL on geometry — report the verdict
+        # leaf by leaf (leaf logical K/N = shape[-2:], identical across the
+        # stacked layer axis): "pallas" only when every projection runs the
+        # kernel, "mixed" when some fall back, "dequant_reference" when all
+        # do — with the first fallback reason named either way
+        reasons = [
+            quant_fallback_reason(leaf.shape[-2], leaf.shape[-1], leaf.bits)
+            for leaf in quantized
+        ]
+        fallbacks = [r for r in reasons if r is not None]
+        quant_mode = None
+        if quantized:
+            if not fallbacks:
+                quant_mode = "pallas"
+            elif len(fallbacks) == len(quantized):
+                quant_mode = "dequant_reference"
+            else:
+                quant_mode = "mixed"
+        return {
+            "use_kernels": self.use_kernels,
+            "paged": self.paged,
+            "decode_attention": "pallas" if self._use_decode_kernel else "gather_reference",
+            "decode_fallback_reason": self._kernel_fallback_reason,
+            "quant_matmul": quant_mode,
+            "quant_fallback_reason": fallbacks[0] if fallbacks else None,
+            "quant_fallback_leaves": len(fallbacks),
+            "quantized_weight_leaves": len(quantized),
+        }
+
+    def _report_kernels(self) -> None:
+        """One ``{"kind": "kernels"}`` record per engine, written at the
+        first step (the hub may attach after construction): a fleet
+        operator greps telemetry.jsonl to see kernel coverage — which
+        engines run the Pallas decode path, which fell back, and why."""
+        if self._kernels_reported or self.telemetry is None:
+            return
+        self._kernels_reported = True
+        payload = self.kernel_summary()
+        if self.name is not None:
+            payload = {"engine": self.name, **payload}
+        self.telemetry.write_record("kernels", payload)
+
     def flush_telemetry(self) -> Optional[dict]:
         """Emit a ``{"kind": "serving", ...}`` record through the hub's
         jsonl sink (no-op without a hub — ``metrics()`` still works)."""
@@ -1978,5 +2141,21 @@ class ServingEngine:
         """Serve from a ``StreamedModel`` — the big-model loader (device
         maps, int8/int4 quantization, disk offload) becomes the serving
         checkpoint path: params reassemble on device via
-        :func:`params_from_streamed`, then decode runs resident."""
+        :func:`params_from_streamed`, then decode runs resident.
+
+        With ``use_kernels`` on (explicitly, or by backend default on TPU)
+        and a quantized streamer, the matrix weights stay PACKED on device
+        (:class:`~.utils.quantization.QuantizedWeight` leaves) and the fused
+        dequant-matmul kernel (ops/quant_matmul.py) is installed as the
+        model's ``dot_fn`` — quantized serving reads 1-byte weights from
+        HBM and the layer-wide bf16 shadow never exists. The dot-keyed jit
+        cache re-keys every program on the hook swap, so engines sharing
+        one model never mix shadowed and fused programs."""
+        use_kernels = kwargs.get("use_kernels")
+        if use_kernels is None:
+            use_kernels = kernels_default()
+        if use_kernels:
+            params = quantized_resident_params(streamed)
+            if params is not None:
+                return cls(streamed.model, params, **kwargs)
         return cls(streamed.model, params_from_streamed(streamed), **kwargs)

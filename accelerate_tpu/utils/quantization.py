@@ -63,7 +63,80 @@ def quantize_weight(w: np.ndarray, bits: int = 8) -> tuple[np.ndarray, np.ndarra
 def dequantize_weight(q: jax.Array, scale: jax.Array, bits: int, dtype=jnp.bfloat16) -> jax.Array:
     """Inverse of ``quantize_weight`` — runs on device inside jit."""
     if bits == 4:
-        low = (q << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
-        high = q >> 4  # arithmetic shift sign-extends the high nibble
-        q = jnp.stack([low, high], axis=1).reshape((-1,) + q.shape[1:])
+        q = unpack_int4(q)
     return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def unpack_int4(q: jax.Array) -> jax.Array:
+    """Nibble-packed int4 → int8 values, doubling the CONTRACTION axis
+    (axis -2 — the packing axis for a ``[K, N]`` matrix, and still the
+    per-layer packing axis when leaves ride stacked as ``[L, K/2, N]``).
+    Packed row ``i`` holds original rows ``2i`` (low nibble) and ``2i + 1``
+    (high nibble); both sign-extend through arithmetic shifts. One
+    definition for the dequant path above and the fused dequant-matmul
+    kernel (ops/quant_matmul.py), pinned directly by tests/test_quantization."""
+    low = (q << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
+    high = q >> 4  # arithmetic shift sign-extends the high nibble
+    out_shape = q.shape[:-2] + (q.shape[-2] * 2, q.shape[-1])
+    return jnp.stack([low, high], axis=-2).reshape(out_shape)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """A quantized matrix living in the params tree AS its packed form.
+
+    The streamed int8/int4 load path historically dequantized every layer to
+    the compute dtype on device (``QuantizedLayerPacker.unpack``), leaving a
+    full bf16 shadow of the weights resident in HBM next to nothing — the
+    quantization saved host RAM and H2D bytes but not serving HBM or matmul
+    read bandwidth. Keeping the leaf packed (this class) lets the fused
+    dequant-matmul kernel (ops/quant_matmul.py) read 1-byte weights straight
+    from HBM and dequantize in VMEM; the bf16 shadow never exists.
+
+    A pytree node (children: ``q`` int8 data, ``scale`` fp32 per-output-
+    channel), so it rides ``jax.lax.scan`` over stacked layers, jit
+    arguments, and ``jax.tree.map`` unchanged. ``shape``/``ndim`` report the
+    LOGICAL (dequantized) geometry so shape-driven code paths need not know.
+    """
+
+    def __init__(self, q: jax.Array, scale: jax.Array, bits: int, dtype=jnp.bfloat16):
+        self.q = q
+        self.scale = scale
+        self.bits = int(bits)
+        self.dtype = jnp.dtype(dtype)
+
+    @property
+    def shape(self) -> tuple:
+        """Logical (dequantized) shape. int4 packs two rows per byte on the
+        matrix's contraction axis — axis -2, so the property stays correct
+        for both a per-layer ``[K, N]`` weight and its stacked ``[L, K, N]``
+        form riding a layer scan."""
+        shape = list(self.q.shape)
+        if self.bits == 4:
+            shape[-2] *= 2
+        return tuple(shape)
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes + self.scale.nbytes)
+
+    def dequantize(self) -> jax.Array:
+        # per-layer form: scale [N] broadcasts against [K, N] as-is; the
+        # stacked form's [L, N] needs the contraction axis inserted
+        scale = self.scale[..., None, :] if self.scale.ndim > 1 else self.scale
+        return dequantize_weight(self.q, scale, self.bits, self.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.bits, str(self.dtype))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        bits, dtype = aux
+        return cls(children[0], children[1], bits, dtype)
+
+    def __repr__(self) -> str:
+        return f"QuantizedWeight(shape={self.shape}, bits={self.bits}, dtype={self.dtype})"

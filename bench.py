@@ -435,6 +435,168 @@ def bench_zero() -> dict:
     return result
 
 
+def bench_kernels() -> dict:
+    """The Pallas kernel layer (ops/: docs/performance.md "Kernel layer"),
+    measured as PAIRED on/off windows — same model, same shapes, same
+    request trace; only ``use_kernels`` flips — mirroring the
+    ``resilience_guard_overhead_pct`` methodology so "faster" is a recorded
+    number, not a claim:
+
+    - ``kernels_decode_step_ms_{off,on}`` — steady-state paged decode step
+      wall time, gather-reference vs page-walk kernel, plus the temp-0
+      token-equality verdict and the kernels-on steady-state compile count
+      (must be 0: page tables ride as arguments either way).
+    - ``kernels_quant_resident_layer_bytes_{shadow,packed}`` — device bytes
+      of the resident layer weights for int8 streamed serving with the
+      dequantized bf16 shadow vs QuantizedWeight + fused dequant-matmul
+      (the shadow-elimination memory audit), plus token equality.
+    - ``kernels_adamw_update_ms_{off,on}`` — eager adamw update wall time
+      over the stacked llama-tiny tree, optax chain vs the fused
+      one-read-one-write kernel, plus the tolerance-0 equality verdict.
+
+    Honest numbers by construction: off-TPU every kernel runs in interpret
+    mode, where the decode kernel happens to WIN on this container (no
+    gather materialization) but the elementwise adamw kernel typically
+    LOSES to XLA's fused chain — the json records whatever the clock says,
+    and the TPU expectation (HBM-bound decode and update both win; see
+    docs/performance.md) is re-measured in a TPU bench round with
+    ``ACCELERATE_PALLAS_INTERPRET=0`` asserting Mosaic lowering."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.models import build_model
+    from accelerate_tpu.ops.fused_adamw import fused_adamw
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.utils.quantization import QuantizedWeight
+
+    t0 = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        print(f"[kernels +{time.perf_counter() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+    _reset_state()
+    name = os.environ.get("BENCH_KERNELS_MODEL", "llama-tiny")
+    num_slots = int(os.environ.get("BENCH_KERNELS_SLOTS", "4"))
+    max_len = int(os.environ.get("BENCH_KERNELS_MAX_LEN", "256"))
+    n_steps = int(os.environ.get("BENCH_KERNELS_STEPS", "32"))
+    prompt_len = min(96, max_len // 2)
+
+    model = build_model(name)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, model.config.vocab_size, (prompt_len + 8 * i,)).astype(np.int32)
+        for i in range(num_slots)
+    ]
+    result: dict = {"kernels_model": name, "kernels_decode_steps": n_steps}
+
+    # -- paired decode window: gather reference vs page-walk kernel ----------
+    tokens: dict = {}
+    for side, use_kernels in (("off", False), ("on", True)):
+        engine = ServingEngine(
+            model, params, num_slots=num_slots, max_len=max_len,
+            use_kernels=use_kernels,
+        )
+        engine.warmup()
+        ids = [engine.submit(p, max_new_tokens=n_steps + 8) for p in prompts]
+        for _ in range(4):  # spin-up: finish prefills, enter steady decode
+            engine.step()
+        # mark BEFORE the timed window: a recompile inside it must both fail
+        # the steady-state gate and be attributable to the inflated step time
+        compiles_mark = engine.compiles.compile_count
+        t1 = time.perf_counter()
+        for _ in range(n_steps):
+            engine.step()
+        elapsed = time.perf_counter() - t1
+        results = engine.run()
+        tokens[side] = [results[i].generated for i in ids]
+        result[f"kernels_decode_step_ms_{side}"] = round(elapsed / n_steps * 1e3, 3)
+        if use_kernels:
+            result["kernels_decode_engaged"] = engine.kernel_summary()["decode_attention"]
+            result["kernels_decode_steady_state_compiles"] = (
+                engine.compiles.compile_count - compiles_mark
+            )
+        _stage(f"decode window {side} done ({elapsed:.1f}s)")
+    result["kernels_decode_tokens_bit_equal"] = bool(
+        all(np.array_equal(a, b) for a, b in zip(tokens["off"], tokens["on"]))
+    )
+    if result["kernels_decode_step_ms_on"]:
+        result["kernels_decode_speedup"] = round(
+            result["kernels_decode_step_ms_off"] / result["kernels_decode_step_ms_on"], 3
+        )
+
+    # -- quantized serving: bf16 shadow vs packed residency ------------------
+    from accelerate_tpu.big_modeling import dispatch_model, make_layered_device_map
+    from accelerate_tpu.utils.quantization import QuantizationConfig
+
+    qmodel = build_model(os.environ.get("BENCH_KERNELS_QUANT_MODEL", "gpt2-tiny"))
+    qparams = qmodel.init(jax.random.key(0))
+    qprompts = [rng.integers(1, qmodel.config.vocab_size, (24,)).astype(np.int32)
+                for _ in range(2)]
+    qtokens: dict = {}
+    for side, use_kernels in (("shadow", False), ("packed", True)):
+        streamed = dispatch_model(
+            qmodel, jax.tree.map(jnp.array, qparams),
+            make_layered_device_map(qmodel, "cpu"), dtype=qparams["embed_tokens"].dtype,
+            quantization=QuantizationConfig(load_in_8bit=True),
+        )
+        engine = ServingEngine.from_streamed(
+            streamed, num_slots=2, max_len=64, use_kernels=use_kernels,
+        )
+        layer_bytes = sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                engine.params["layers"],
+                is_leaf=lambda x: isinstance(x, QuantizedWeight),
+            )
+        )
+        result[f"kernels_quant_resident_layer_bytes_{side}"] = int(layer_bytes)
+        qtokens[side] = engine.generate_many(qprompts, max_new_tokens=8)
+        _stage(f"quant window {side} done")
+    qmodel.dot_fn = None  # detach the hook: the model object may be reused
+    result["kernels_quant_shadow_eliminated_ratio"] = round(
+        result["kernels_quant_resident_layer_bytes_shadow"]
+        / result["kernels_quant_resident_layer_bytes_packed"], 3,
+    )
+    result["kernels_quant_tokens_bit_equal"] = bool(
+        all(np.array_equal(a, b) for a, b in zip(qtokens["shadow"], qtokens["packed"]))
+    )
+
+    # -- paired adamw update window: optax chain vs fused kernel -------------
+    update_steps = int(os.environ.get("BENCH_KERNELS_ADAMW_STEPS", "24"))
+    grads0 = jax.tree.map(lambda p: jnp.ones(p.shape, jnp.float32), params)
+    adamw_params: dict = {}
+    for side, tx in (("off", optax.adamw(1e-3)), ("on", fused_adamw(1e-3))):
+        p = jax.tree.map(jnp.array, params)
+        state = tx.init(p)
+
+        fused_apply = getattr(tx, "fused_apply", None)
+
+        def step_fn(p, s, g, _fused=fused_apply, _tx=tx):
+            if _fused is not None:
+                return _fused(p, s, g)
+            updates, s = _tx.update(g, s, p)
+            return optax.apply_updates(p, updates), s
+
+        step = jax.jit(step_fn, donate_argnums=(0, 1))
+        p, state = step(p, state, grads0)  # compile outside the window
+        t1 = time.perf_counter()
+        for _ in range(update_steps):
+            p, state = step(p, state, grads0)
+        jax.block_until_ready(p)
+        elapsed = time.perf_counter() - t1
+        result[f"kernels_adamw_update_ms_{side}"] = round(elapsed / update_steps * 1e3, 3)
+        adamw_params[side] = jax.tree.map(np.asarray, p)
+        _stage(f"adamw window {side} done")
+    result["kernels_adamw_bit_equal"] = bool(
+        all(jax.tree.leaves(jax.tree.map(np.array_equal, adamw_params["off"], adamw_params["on"])))
+    )
+    return result
+
+
 def _llama_train_bench(
     name, batch_size, seq_len, n_steps, prefix, include_model_key=False, zero_stage=None
 ) -> dict:
@@ -2000,6 +2162,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "zero":
         print(json.dumps(bench_zero()))
         return
+    if os.environ.get("BENCH_ONLY") == "kernels":
+        print(json.dumps(bench_kernels()))
+        return
     if os.environ.get("BENCH_ONLY") == "observability":
         print(json.dumps(bench_observability()))
         return
@@ -2039,6 +2204,7 @@ def main() -> None:
         ("llama_fsdp", bench_llama_fsdp, ("llama_fsdp_train_mfu",)),
         ("llama_seq4096", bench_llama_longseq, ("llama_seq4096_train_mfu",)),
         ("zero", bench_zero, ()),
+        ("kernels", bench_kernels, ()),
         ("bigmodel", lambda: _bench_subprocess("bigmodel"), ("bigmodel_int8_ratio",)),
         # 1800s outer > 1400s inner + middle-process jax/TPU-client init and
         # ambient probe (~100-300s): the INNER timeout always fires first, so

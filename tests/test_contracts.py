@@ -48,6 +48,10 @@ REQUIRED_CONTRACTS = {
     "bert_tiny_step",
     "llama_tiny_fsdp_step",
     "serving_decode",
+    # ISSUE 15: the kernel-enabled decode (Pallas page-walk attention) is a
+    # different program with the same obligations — donation intact, page
+    # tables as arguments — pinned under its own contract
+    "serving_decode_kernels",
     "serving_prefill_16",
     "serving_prefill_32",
     "serving_prefill_64",

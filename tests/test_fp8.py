@@ -65,6 +65,49 @@ def test_fp8_accelerator_wires_dot_fn_and_trains():
     assert losses[-1] < losses[0]
 
 
+def test_quantize_e4m3_saturates_exactly_at_amax():
+    """The per-tensor scale maps the tensor's abs-max onto E4M3_MAX exactly
+    (margin 0), so the largest magnitude survives the cast unclipped and
+    nothing overflows to inf."""
+    x = jnp.asarray([[-3.0, 0.25], [1.5, 12.0]], jnp.float32)
+    q, scale = quantize_e4m3(x)
+    back = np.asarray(q.astype(jnp.float32))
+    assert float(scale) == pytest.approx(12.0 / E4M3_MAX)
+    assert np.isfinite(back).all()
+    assert np.abs(back).max() == pytest.approx(E4M3_MAX)
+
+
+def test_quantize_e4m3_margin_headroom():
+    """Each margin bit doubles the scale (TE recipe parity): the quantized
+    range shrinks by 2^margin, buying overflow headroom for values that
+    grow between scale updates."""
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(8, 8)).astype(np.float32))
+    _, s0 = quantize_e4m3(x, margin=0)
+    q1, s1 = quantize_e4m3(x, margin=1)
+    assert float(s1) == pytest.approx(2.0 * float(s0))
+    assert np.abs(np.asarray(q1.astype(jnp.float32))).max() <= E4M3_MAX / 2 + 1e-3
+
+
+def test_quantize_e4m3_zero_tensor_no_nan():
+    """An all-zero operand exercises the scale floor: no 0/0, quantized
+    values and scale both finite."""
+    q, scale = quantize_e4m3(jnp.zeros((4, 4), jnp.float32))
+    assert np.isfinite(float(scale))
+    np.testing.assert_array_equal(np.asarray(q.astype(jnp.float32)), 0.0)
+    out = fp8_dot(jnp.zeros((2, 4), jnp.float32), jnp.zeros((4, 3), jnp.float32))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_fp8_dot_output_dtype_follows_x():
+    """The hook contract: output rides x's dtype whatever the compute did —
+    bf16 activations stay bf16 through a quantized projection."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(2, 16)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    out = fp8_dot(x, w)
+    assert out.dtype == jnp.bfloat16 and out.shape == (2, 4)
+
+
 def test_fp8_output_differs_from_bf16():
     """fp8 must be observably different from the old silent-bf16 behavior."""
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState

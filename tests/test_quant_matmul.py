@@ -1,0 +1,144 @@
+"""Fused int8/int4 dequant-matmul (ops/quant_matmul.py): kernel vs the
+dequantize-then-matmul reference, the QuantizedWeight pytree contract, and
+the quantized-resident serving path that eliminates the bf16 shadow."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.quant_matmul import quant_dot, quant_matmul
+from accelerate_tpu.utils.quantization import (
+    QuantizedWeight,
+    dequantize_weight,
+    quantize_weight,
+)
+
+
+def _quantized(rng, k, n, bits):
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    q, scale = quantize_weight(w, bits=bits)
+    return QuantizedWeight(jnp.asarray(q), jnp.asarray(scale), bits, jnp.float32)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_fused_matmul_matches_dequant_reference(bits):
+    rng = np.random.default_rng(bits)
+    qw = _quantized(rng, 64, 48, bits)
+    x = jnp.asarray(rng.normal(size=(2, 5, 64)).astype(np.float32))
+    got = quant_matmul(x, qw)
+    want = x @ dequantize_weight(qw.q, qw.scale, bits, jnp.float32)
+    assert got.shape == (2, 5, 48)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=1e-5)
+
+
+def test_fused_matmul_blocked_k_accumulation():
+    """K larger than one block: the revisited-output accumulation over K
+    blocks must equal the single contraction."""
+    rng = np.random.default_rng(7)
+    qw = _quantized(rng, 2048, 16, 8)  # 4 K-blocks at the 512 ceiling
+    x = jnp.asarray(rng.normal(size=(3, 2048)).astype(np.float32) / 32.0)
+    got = quant_matmul(x, qw)
+    want = x @ qw.dequantize().astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_quant_dot_passthrough_for_plain_arrays():
+    x = jnp.ones((2, 8))
+    w = jnp.full((8, 3), 2.0)
+    np.testing.assert_array_equal(np.asarray(quant_dot(x, w)), np.asarray(x @ w))
+
+
+def test_quantized_weight_pytree_rides_scan_and_stack():
+    """The packed container must survive jnp.stack via tree.map (the layer
+    stacker) and lax.scan leading-axis slicing (the layer loop) with its
+    bits/dtype aux intact."""
+    rng = np.random.default_rng(0)
+    layers = [_quantized(rng, 8, 6, 8) for _ in range(3)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    assert isinstance(stacked, QuantizedWeight)
+    assert stacked.q.shape == (3, 8, 6) and stacked.scale.shape == (3, 6)
+    assert stacked.shape == (3, 8, 6)
+
+    def body(carry, qw):
+        return carry + quant_matmul(jnp.ones((1, 8)), qw).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), stacked)
+    want = sum(float((jnp.ones((1, 8)) @ l.dequantize().astype(jnp.float32)).sum()) for l in layers)
+    assert np.isclose(float(total), want, rtol=1e-5)
+
+
+def test_int4_stacked_dequantize_doubles_contraction_axis():
+    """A STACKED int4 leaf [L, K/2, N] (the layer-scan form) must
+    dequantize to [L, K, N] with each layer's rows interleaved on axis -2 —
+    not the layer axis — and match the per-layer dequant exactly."""
+    rng = np.random.default_rng(2)
+    layers = [_quantized(rng, 16, 6, 4) for _ in range(3)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    deq = np.asarray(stacked.dequantize())
+    assert deq.shape == (3, 16, 6) == stacked.shape
+    for i, layer in enumerate(layers):
+        np.testing.assert_array_equal(deq[i], np.asarray(layer.dequantize()))
+
+
+def test_int4_logical_shape_and_contraction():
+    rng = np.random.default_rng(1)
+    qw = _quantized(rng, 32, 8, 4)
+    assert qw.q.shape == (16, 8)  # two rows per stored byte
+    assert qw.shape == (32, 8)
+    out = quant_matmul(jnp.ones((1, 32), jnp.float32), qw)
+    assert out.shape == (1, 8)
+
+
+def test_quantized_resident_serving_eliminates_shadow():
+    """from_streamed(use_kernels=True) on an int8 streamer keeps matrix
+    weights PACKED (QuantizedWeight leaves), installs the fused dot hook,
+    serves the same tokens as the shadowed reference at temperature 0, and
+    the resident layer bytes drop by more than 2x (int8 + fp32 sidecar vs
+    the fp32/bf16 shadow)."""
+    from accelerate_tpu.big_modeling import dispatch_model, make_layered_device_map
+    from accelerate_tpu.models import GPT2
+    from accelerate_tpu.ops.quant_matmul import quant_dot as expected_hook
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.utils.quantization import QuantizationConfig
+
+    model = GPT2("gpt2-tiny")
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 19)]
+
+    def streamed():
+        return dispatch_model(
+            model, jax.tree.map(jnp.array, params),
+            make_layered_device_map(model, "cpu"), dtype=jnp.float32,
+            quantization=QuantizationConfig(load_in_8bit=True),
+        )
+
+    def layer_bytes(engine):
+        return sum(
+            leaf.nbytes for leaf in jax.tree.leaves(
+                engine.params["layers"], is_leaf=lambda x: isinstance(x, QuantizedWeight)
+            )
+        )
+
+    try:
+        ref_engine = ServingEngine.from_streamed(
+            streamed(), num_slots=2, max_len=64, use_kernels=False
+        )
+        ref_rows = ref_engine.generate_many(prompts, max_new_tokens=6)
+        assert model.dot_fn is None  # the shadowed path installs nothing
+
+        eng = ServingEngine.from_streamed(
+            streamed(), num_slots=2, max_len=64, use_kernels=True
+        )
+        assert model.dot_fn is expected_hook
+        summary = eng.kernel_summary()
+        assert summary["quant_matmul"] == "pallas"
+        assert summary["quantized_weight_leaves"] > 0
+        rows = eng.generate_many(prompts, max_new_tokens=6)
+        assert all(np.array_equal(a, b) for a, b in zip(ref_rows, rows))
+        assert layer_bytes(eng) * 2 < layer_bytes(ref_engine)
+    finally:
+        model.dot_fn = None  # detach: the module-scoped model may be shared

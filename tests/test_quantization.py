@@ -36,6 +36,50 @@ def test_quantize_roundtrip_int4_packs_two_per_byte():
     assert np.abs(back - w).max() <= (scale.max() / 2) + 1e-6
 
 
+def test_int4_nibble_sign_extension_all_values():
+    """Every representable int4 value round-trips the nibble packing exactly:
+    pack all (low, high) pairs over [-7, 7] by hand, and ``unpack_int4``'s
+    arithmetic-shift sign extension must reproduce them — negatives included
+    — interleaved as rows 2i (low) / 2i+1 (high). This pins the shift
+    semantics at utils/quantization.py directly against an integer
+    reference instead of through a statistical round-trip."""
+    from accelerate_tpu.utils.quantization import unpack_int4
+
+    values = np.arange(-7, 8, dtype=np.int8)  # the symmetric-quantizer range
+    low, high = np.meshgrid(values, values, indexing="ij")
+    low, high = low.ravel(), high.ravel()
+    packed = ((low & 0x0F) | ((high & 0x0F) << 4)).astype(np.int8)[:, None]
+    unpacked = np.asarray(unpack_int4(jnp.asarray(packed)))
+    assert unpacked.dtype == np.int8
+    np.testing.assert_array_equal(unpacked[0::2, 0], low)
+    np.testing.assert_array_equal(unpacked[1::2, 0], high)
+
+
+def test_int4_dequantize_matches_float_reference():
+    """dequantize_weight(bits=4) against a pure-numpy reference of the same
+    spec: unpack both nibbles with sign, multiply by the per-channel scale —
+    exact equality, not tolerance (the device path must not add rounding)."""
+    rng = np.random.default_rng(5)
+    w = rng.normal(size=(16, 6)).astype(np.float32)
+    q, scale = quantize_weight(w, bits=4)
+    got = np.asarray(dequantize_weight(jnp.asarray(q), jnp.asarray(scale), 4, jnp.float32))
+
+    # numpy reference: low nibble rows 2i, high nibble rows 2i+1, sign-extended
+    low = (q.astype(np.int8) << 4).astype(np.int8) >> 4
+    high = q.astype(np.int8) >> 4
+    vals = np.empty((q.shape[0] * 2,) + q.shape[1:], np.int8)
+    vals[0::2], vals[1::2] = low, high
+    want = vals.astype(np.float32) * scale.astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    # and the reference itself is a faithful quantization of w
+    assert np.abs(want - w).max() <= (scale.max() / 2) + 1e-6
+
+
+def test_int4_odd_leading_dim_rejected():
+    with pytest.raises(ValueError, match="even leading dim"):
+        quantize_weight(np.ones((3, 4), np.float32), bits=4)
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         QuantizationConfig()
