@@ -34,6 +34,7 @@ from .resilience import (
     ElasticConfig,
     ElasticCoordinator,
     ElasticFailure,
+    DictStore,
     FaultPlan,
     FilesystemStore,
     GuardPolicy,
@@ -45,6 +46,15 @@ from .resilience import (
 )
 from .telemetry import Telemetry, TelemetryConfig
 from .parallel.local_sgd import LocalSGD
+from .parallel.redistribute import (
+    EpochFence,
+    RedistributeConfig,
+    RedistributeError,
+    RedistributePlan,
+    RedistributeStageFailure,
+    plan_redistribute,
+    redistribute,
+)
 from .scheduler import AcceleratedScheduler
 from . import ops
 from .utils import (

@@ -270,6 +270,22 @@ def _self_check(compile: bool):
         tracer=RequestTracer(),
     )
     reports.append(router.analyze(compile=compile, write_record=False))
+
+    # -- the redistribution stage program (parallel/redistribute.py): the
+    # chunk-commit every recovery transfer's staged path runs — destination
+    # DONATED so the stage's in-flight footprint is one chunk. The memory
+    # audit runs with an hbm budget derived from the scratch bound, so
+    # "bounded peak memory" is checked by the PR 8 pass, not claimed
+    from ..analysis import audit_lowered
+    from ..parallel.redistribute import canonical_redistribute_program
+
+    lowered, budget = canonical_redistribute_program()
+    reports.append(
+        audit_lowered(
+            lowered, compile=compile, label="redistribute_stage",
+            expect_donation=True, hbm_budget_bytes=budget,
+        )
+    )
     return reports
 
 

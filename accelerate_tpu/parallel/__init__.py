@@ -8,3 +8,12 @@ from .sharding import (
     shardings_like,
 )
 from .local_sgd import LocalSGD
+from .redistribute import (
+    EpochFence,
+    RedistributeConfig,
+    RedistributeError,
+    RedistributePlan,
+    RedistributeStageFailure,
+    plan_redistribute,
+    redistribute,
+)

@@ -1,0 +1,817 @@
+"""One redistribution primitive for every recovery path.
+
+Three subsystems used to fake the same operation through host RAM: the
+disaggregated KV handoff moved parked pages device→host→device, the elastic
+buddy reassembly relayed ZeRO shards one leaf at a time, and ``regrow()``
+round-tripped the whole state through the coordinator. All three are the
+same problem — redistribute a sharded tree from mesh A's layout to mesh B's,
+where A and B may not share devices — and *Memory-efficient array
+redistribution through portable collective communication* (arXiv:2112.01075)
+shows the general relayout decomposes into all-to-all / collective-permute /
+slice stages with provably bounded per-chip scratch. This module is that
+primitive, built recovery-grade:
+
+- **Planned, then executed.** :func:`plan_redistribute` walks sharding
+  metadata ONLY (``devices_indices_map``, never shard data) and decides
+  everything before a byte moves: which rung (staged collectives vs the
+  per-leaf host relay), which collective kind each leaf lowers to
+  (``identity`` / ``collective_permute`` / ``all_to_all`` / ``device_put``),
+  and how each leaf is chunked so no stage stages more than
+  ``RedistributeConfig(max_scratch_bytes=)`` at once. The host-relay rung's
+  plan step is the same metadata-only coverage pre-check the elastic ladder
+  uses (:func:`tree_covered` lives here now) — "decided before a byte moves"
+  is one piece of code, not two.
+
+- **Bounded scratch, audited not claimed.** A leaf bigger than the scratch
+  bound is moved in chunks: slice a chunk off the live source, relayout it
+  to the destination sharding, and commit it into a preallocated destination
+  buffer with a DONATED ``dynamic_update_slice`` — the destination buffer is
+  committed state, not scratch, so the in-flight footprint is one chunk.
+  The chunk-commit program is the canonical ``redistribute_stage`` contract
+  program: ``analyze --self-check`` runs the PR 8 memory audit over it with
+  an ``hbm_budget_bytes`` derived from the scratch bound, so the claim is
+  gated, and :data:`tests/contracts/redistribute_stage.json` pins donation,
+  the collective inventory, and the peak-HBM shape.
+
+- **Transactional.** Source buffers are NEVER donated; every new leaf is
+  built beside the old tree, and only after the whole tree (and the epoch
+  fence, below) passes does :func:`redistribute` return it — the commit.
+  A failure at any stage leaves the caller holding the intact source.
+
+- **Chaos-drilled mid-transfer failure.** ``FaultPlan`` grows
+  ``redistribute_fail_at`` / ``redistribute_fail_stage``
+  (``ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_AT/_STAGE``): kill stage *k* of
+  transfer *n* and the ladder runs staged → host relay (re-reading the
+  intact source) → fail loud NAMING the stage when the relay is disabled or
+  impossible. The outcome lands in telemetry either way.
+
+- **Epoch-fenced commit.** A zombie coordinator's in-flight transfer is
+  refused at commit: :class:`EpochFence` captures the PR 14 membership epoch
+  the transfer was planned under and re-reads the store at commit; a view
+  that moved on raises ``StaleEpochError`` and the telemetry record says
+  ``stale_epoch_write_rejected`` — the source is untouched, the new buffers
+  are dropped.
+
+- **Observable.** Every transfer writes one ``{"kind": "redistribute"}``
+  record: rung, per-kind stage counts, bytes moved, peak scratch vs the
+  bound, wall time, outcome, and ``trace_id`` when the transfer is
+  request-scoped (the KV handoff passes the request id).
+
+At CPU scale (the tier-1 simulation) the staged rung's relayout executes
+through XLA's transfer engine (``jax.device_put``), which on a pod lowers
+the same plan to ICI collectives — the plan's stage kinds are the
+decomposition 2112.01075 names, recorded honestly as what WOULD run on
+chips. The host-relay rung is not a test shim: it is the degenerate rung
+the ladder needs anyway (dead devices cannot join a collective), so tier-1
+drills both paths and a tolerance-0 bit-equality gate pins staged == relay.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# default per-chip scratch bound: 64 MiB — small enough that a recovery
+# transfer can never OOM the survivors it is saving, large enough that
+# CPU-scale trees move in one stage per leaf
+DEFAULT_MAX_SCRATCH_BYTES = 64 << 20
+
+
+class RedistributeError(RuntimeError):
+    """A redistribution that could not complete on any rung. The message
+    names the failing stage — fail-loud is the ladder's last rung."""
+
+
+class RedistributeStageFailure(RedistributeError):
+    """One staged-path stage died mid-transfer (chaos or a real collective
+    failure). The source is intact (nothing is donated); callers — or
+    :func:`redistribute` itself — degrade to the host relay."""
+
+    def __init__(self, message: str, *, stage: int, kind: str, leaf: str):
+        super().__init__(message)
+        self.stage = int(stage)
+        self.kind = kind
+        self.leaf = leaf
+
+
+@dataclass(frozen=True)
+class RedistributeConfig:
+    """Policy for one transfer.
+
+    ``max_scratch_bytes`` bounds the bytes any single stage holds in flight
+    (the chunk the staged path slices/moves/commits at a time; the largest
+    leaf's host buffer on the relay rung is reported against the same bound).
+    ``force_path`` pins a rung: ``"staged"`` disables the relay fallback
+    (a mid-stage failure then fails loud, naming the stage), ``"relay"``
+    skips the staged path entirely; ``None`` (default) lets the plan decide
+    and the ladder degrade."""
+
+    max_scratch_bytes: int = DEFAULT_MAX_SCRATCH_BYTES
+    force_path: Optional[str] = None  # None | "staged" | "relay"
+
+    def __post_init__(self):
+        if self.force_path not in (None, "staged", "relay"):
+            raise ValueError(
+                f"force_path must be None, 'staged' or 'relay', got {self.force_path!r}"
+            )
+        if int(self.max_scratch_bytes) <= 0:
+            raise ValueError("max_scratch_bytes must be positive")
+
+
+@dataclass(frozen=True)
+class Stage:
+    """One unit of the decomposition: what moves, how, and how big. The
+    global ``index`` is what the chaos leg targets."""
+
+    index: int
+    leaf: str
+    kind: str  # identity | collective_permute | all_to_all | device_put | host_relay
+    nbytes: int
+    # staged-path chunking: (axis, start, size) slab of the leaf, or None
+    # when the stage moves the whole leaf in one piece
+    chunk: Optional[tuple[int, int, int]] = None
+
+
+@dataclass
+class RedistributePlan:
+    """The decomposition, decided from sharding metadata before a byte
+    moves. ``rung`` is the transfer path; ``covered`` (relay rung only) is
+    the metadata-only coverage verdict the elastic ladder keys its rung
+    decision on."""
+
+    rung: str  # "staged" | "host_relay"
+    reason: str
+    stages: list[Stage] = field(default_factory=list)
+    num_leaves: int = 0
+    total_bytes: int = 0
+    peak_scratch_bytes: int = 0
+    max_scratch_bytes: int = DEFAULT_MAX_SCRATCH_BYTES
+    covered: bool = True
+
+    @property
+    def stage_kinds(self) -> dict:
+        return dict(Counter(s.kind for s in self.stages))
+
+
+class EpochFence:
+    """The PR 14 zombie fence, applied to a transfer's COMMIT: capture the
+    membership epoch the transfer was planned under; :meth:`check` re-reads
+    the store and raises :class:`~..resilience.membership.StaleEpochError`
+    when the view moved on — the in-flight transfer belongs to a fenced-out
+    coordinator and must not become live state."""
+
+    def __init__(self, store: Any, epoch: int):
+        self.store = store
+        self.epoch = int(epoch)
+
+    def check(self) -> None:
+        from ..resilience.membership import EPOCH_KEY, StaleEpochError
+
+        current = self.store.read(EPOCH_KEY)
+        if current is not None and int(current.get("epoch", 0)) > self.epoch:
+            raise StaleEpochError(
+                "redistribute/commit", self.epoch, int(current["epoch"])
+            )
+
+
+# ---------------------------------------------------------------------------
+# survivor-side reassembly — the host-relay rung's read path (moved from
+# resilience/elastic.py: the rung decision and the relay are the fallback
+# half of THIS primitive, and the elastic ladder imports them from here)
+# ---------------------------------------------------------------------------
+
+
+def _index_key(index: tuple, shape: tuple) -> tuple:
+    """Normalize a shard's global-slice index so primary and buddy shards of
+    the same region compare equal (None-bounded slices vs explicit ones)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def assemble_from_survivors(
+    primary: jax.Array,
+    lost_ids: "set[int]",
+    buddy: Optional[jax.Array] = None,
+) -> Optional[np.ndarray]:
+    """Reassemble one global array on host from shards on SURVIVING devices
+    only — the elastic read primitive. Shards whose device id is in
+    ``lost_ids`` are never touched (the simulation's honesty guarantee: a
+    dead host's HBM is unreadable). Missing regions are filled from the
+    ``buddy`` copy's surviving shards; returns None when coverage is still
+    incomplete (primary and buddy both lost — the caller's ladder falls
+    through to the next rung)."""
+    shape = tuple(primary.shape)
+    out = np.empty(shape, dtype=primary.dtype)
+    needed = {
+        _index_key(idx, shape)
+        for idx in primary.sharding.devices_indices_map(shape).values()
+    }
+    have: set = set()
+    for source in (primary, buddy):
+        if source is None:
+            continue
+        for shard in source.addressable_shards:
+            if shard.device.id in lost_ids:
+                continue
+            key = _index_key(shard.index, shape)
+            if key in have:
+                continue
+            out[shard.index] = np.asarray(shard.data)
+            have.add(key)
+        if needed <= have:
+            return out
+    return None
+
+
+def _leaf_covered(primary: jax.Array, lost_ids: "set[int]", buddy=None) -> bool:
+    """Coverage pre-check WITHOUT reading any shard data: do the surviving
+    (primary ∪ buddy) shards tile the whole array? Walks sharding metadata
+    only, so the ladder can decide its rung before moving a byte."""
+    shape = tuple(primary.shape)
+    needed = {
+        _index_key(idx, shape)
+        for idx in primary.sharding.devices_indices_map(shape).values()
+    }
+    have: set = set()
+    for source in (primary, buddy):
+        if source is None:
+            continue
+        for device, idx in source.sharding.devices_indices_map(shape).items():
+            if device.id not in lost_ids:
+                have.add(_index_key(idx, shape))
+    return needed <= have
+
+
+def tree_covered(primary_tree: Any, lost_ids: "set[int]", buddy_tree: Any = None) -> bool:
+    """Whether every leaf of the tree survives the loss (metadata-only)."""
+    if buddy_tree is None:
+        flags = jax.tree.map(lambda p: _leaf_covered(p, lost_ids), primary_tree)
+    else:
+        flags = jax.tree.map(
+            lambda p, b: _leaf_covered(p, lost_ids, b), primary_tree, buddy_tree
+        )
+    return all(jax.tree.leaves(flags))
+
+
+def relay_tree(
+    primary_tree: Any,
+    lost_ids: "set[int]",
+    buddy_tree: Any,
+    new_shardings: Any,
+) -> Any:
+    """The host-relay rung: relay a state tree onto a new mesh through
+    surviving shards, ONE LEAF AT A TIME — assemble the leaf on host,
+    ``device_put`` it to its new sharding, drop the host copy. Peak host
+    memory is bounded by the largest leaf, never the whole state (the CPU
+    analogue of 2112.01075's no-full-buffer redistribution). Callers
+    pre-check :func:`tree_covered`; an uncovered leaf here is a programming
+    error and raises."""
+
+    def _leaf(p, b, s):
+        host = assemble_from_survivors(p, lost_ids, b)
+        if host is None:
+            raise RedistributeError(
+                "internal: relay_tree called for a leaf whose surviving "
+                "shards do not cover it (coverage must be checked first)"
+            )
+        return jax.device_put(host, s)
+
+    if buddy_tree is None:
+        return jax.tree.map(
+            lambda p, s: _leaf(p, None, s), primary_tree, new_shardings
+        )
+    return jax.tree.map(_leaf, primary_tree, buddy_tree, new_shardings)
+
+
+# ---------------------------------------------------------------------------
+# planning: metadata only — kind classification, chunking, rung decision
+# ---------------------------------------------------------------------------
+
+
+def _index_multimap(shape: tuple, sharding) -> dict:
+    return {
+        d.id: _index_key(idx, shape)
+        for d, idx in sharding.devices_indices_map(shape).items()
+    }
+
+
+def _leaf_kind(shape: tuple, src_sharding, dst_sharding) -> str:
+    """Which collective the relayout of one leaf lowers to on a pod, per the
+    2112.01075 decomposition — decided entirely from the two shardings'
+    device→index maps."""
+    smap = _index_multimap(shape, src_sharding)
+    dmap = _index_multimap(shape, dst_sharding)
+    if smap == dmap:
+        return "identity"
+    if smap.keys() == dmap.keys():
+        if Counter(smap.values()) == Counter(dmap.values()):
+            # same tiling, shards change owners: a pure device permutation
+            return "collective_permute"
+        return "all_to_all"  # the tiling itself changes: shards split/merge
+    if set(smap) & set(dmap):
+        return "all_to_all"  # overlapping device sets resharding across both
+    return "device_put"  # disjoint meshes: cross-slice send/recv
+
+
+def _partitions_along(sharding, shape: tuple, axis: int) -> int:
+    """How many ways ``sharding`` tiles ``axis`` — chunk extents must stay a
+    multiple of this, because each chunk is relaid directly onto the
+    destination sharding and an uneven extent cannot be tiled."""
+    try:
+        return max(int(shape[axis]) // int(sharding.shard_shape(shape)[axis]), 1)
+    except Exception:
+        return 1
+
+
+def _chunk_stages(shape: tuple, nbytes: int, max_scratch: int, dst_sharding=None):
+    """Chunk a leaf along its largest axis so no stage stages more than
+    ``max_scratch`` bytes, keeping every chunk a multiple of the destination
+    tiling along that axis. None → the leaf moves whole (already under the
+    bound, or unchunkable: a singleton axis, or a tiling whose minimal slab
+    is the whole axis)."""
+    if nbytes <= max_scratch or not shape or max(shape) <= 1:
+        return None
+    axis = int(np.argmax(shape))
+    dim = int(shape[axis])
+    parts = _partitions_along(dst_sharding, tuple(shape), axis) if dst_sharding is not None else 1
+    row_bytes = max(nbytes // dim, 1)
+    size = max(int(max_scratch // row_bytes), 1)
+    # floor: one slab per destination partition of the axis — smaller cannot
+    # be relaid onto the tiling, so a slab over the bound is the honest
+    # minimum (the plan still reports it as peak_scratch_bytes)
+    size = max((size // parts) * parts, parts)
+    if size >= dim:
+        return None
+    return [
+        (axis, start, min(size, dim - start)) for start in range(0, dim, size)
+    ]
+
+
+def _leaf_paths(tree: Any) -> list[str]:
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(kp) or f"[{i}]" for i, (kp, _) in enumerate(paths)]
+
+
+def plan_redistribute(
+    tree: Any,
+    dst_shardings: Any,
+    *,
+    lost_device_ids: "frozenset[int] | set[int]" = frozenset(),
+    buddy_tree: Any = None,
+    config: Optional[RedistributeConfig] = None,
+) -> RedistributePlan:
+    """Decide the whole transfer from sharding metadata, before a byte
+    moves. The rung decision IS the elastic ladder's: lost devices (or a
+    buddy merge, which reads two source copies) force the host-relay rung —
+    dead devices cannot join a collective, and the relay is the only path
+    that can stitch primary+buddy shards — and its plan step is the
+    :func:`tree_covered` verdict. A pure relayout (nothing lost, one source)
+    takes the staged rung."""
+    config = config or RedistributeConfig()
+    lost = set(lost_device_ids)
+    leaves = jax.tree.leaves(tree)
+    paths = _leaf_paths(tree)
+    total = sum(int(leaf.nbytes) for leaf in leaves)
+
+    relay_reason = None
+    if config.force_path == "relay":
+        relay_reason = "forced by config"
+    elif lost:
+        relay_reason = f"{len(lost)} lost device(s): survivors-only host read"
+    elif buddy_tree is not None:
+        relay_reason = "buddy merge: two source copies stitch on host"
+
+    if relay_reason is not None:
+        covered = tree_covered(tree, lost, buddy_tree)
+        stages = [
+            Stage(index=i, leaf=path, kind="host_relay", nbytes=int(leaf.nbytes))
+            for i, (path, leaf) in enumerate(zip(paths, leaves))
+        ]
+        return RedistributePlan(
+            rung="host_relay",
+            reason=relay_reason,
+            stages=stages,
+            num_leaves=len(leaves),
+            total_bytes=total,
+            # the relay's in-flight footprint is one leaf's host buffer
+            peak_scratch_bytes=max((s.nbytes for s in stages), default=0),
+            max_scratch_bytes=int(config.max_scratch_bytes),
+            covered=covered,
+        )
+
+    dst_leaves = jax.tree.leaves(dst_shardings)
+    if len(dst_leaves) != len(leaves):
+        raise ValueError(
+            f"redistribute: tree has {len(leaves)} leaves but dst_shardings "
+            f"has {len(dst_leaves)}"
+        )
+    stages: list[Stage] = []
+    index = 0
+    peak = 0
+    for path, leaf, dst in zip(paths, leaves, dst_leaves):
+        shape = tuple(leaf.shape)
+        kind = _leaf_kind(shape, leaf.sharding, dst)
+        if kind == "identity":
+            continue  # nothing moves; the executor re-binds the sharding
+        chunks = _chunk_stages(shape, int(leaf.nbytes), int(config.max_scratch_bytes), dst)
+        if chunks is None:
+            stages.append(Stage(index=index, leaf=path, kind=kind, nbytes=int(leaf.nbytes)))
+            peak = max(peak, int(leaf.nbytes))
+            index += 1
+        else:
+            dim = shape[chunks[0][0]]
+            for axis, start, size in chunks:
+                chunk_bytes = int(leaf.nbytes) * size // dim
+                stages.append(
+                    Stage(
+                        index=index, leaf=path, kind=kind,
+                        nbytes=chunk_bytes, chunk=(axis, start, size),
+                    )
+                )
+                peak = max(peak, chunk_bytes)
+                index += 1
+    return RedistributePlan(
+        rung="staged",
+        reason="pure relayout: every source shard readable",
+        stages=stages,
+        num_leaves=len(leaves),
+        total_bytes=total,
+        peak_scratch_bytes=peak,
+        max_scratch_bytes=int(config.max_scratch_bytes),
+    )
+
+
+# ---------------------------------------------------------------------------
+# staged execution: slice → relayout → donated commit, one chunk in flight
+# ---------------------------------------------------------------------------
+
+# program caches keyed on everything that changes the compiled program —
+# steady-state transfers of the same tree shapes compile NOTHING (the bench
+# asserts 0 recompiles on the second transfer)
+_ZEROS_PROGRAMS: dict = {}
+_SLICE_PROGRAMS: dict = {}
+_UPDATE_PROGRAMS: dict = {}
+
+
+def _alloc_dest(shape: tuple, dtype, sharding) -> jax.Array:
+    """Preallocate the destination buffer ON its destination sharding. This
+    is committed state being built, not scratch: the transfer's in-flight
+    footprint stays one chunk."""
+    key = (shape, jnp.dtype(dtype).name, sharding)
+    fn = _ZEROS_PROGRAMS.get(key)
+    if fn is None:
+        fn = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+        _ZEROS_PROGRAMS[key] = fn
+    return fn()
+
+
+def _slice_chunk(leaf: jax.Array, axis: int, start: int, size: int) -> jax.Array:
+    """Slice one chunk off the live (sharded) source. ``start`` rides as a
+    traced argument so every body chunk shares one program."""
+    key = (axis, size)
+    fn = _SLICE_PROGRAMS.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda x, s: jax.lax.dynamic_slice_in_dim(x, s, size, axis=axis)
+        )
+        _SLICE_PROGRAMS[key] = fn
+    return fn(leaf, jnp.int32(start))
+
+
+def _update_fn(axis: int):
+    def _commit(dest, chunk, start):
+        return jax.lax.dynamic_update_slice_in_dim(dest, chunk, start, axis=axis)
+
+    return _commit
+
+
+def _commit_chunk(dest, chunk, axis: int, start: int, dst_sharding):
+    """The canonical stage program (``redistribute_stage`` contract): commit
+    one relocated chunk into the destination buffer with the buffer DONATED
+    — peak HBM for the stage is the chunk plus the alias-excluded dest."""
+    key = (axis, dst_sharding)
+    fn = _UPDATE_PROGRAMS.get(key)
+    if fn is None:
+        fn = jax.jit(_update_fn(axis), donate_argnums=(0,), out_shardings=dst_sharding)
+        _UPDATE_PROGRAMS[key] = fn
+    return fn(dest, chunk, jnp.int32(start))
+
+
+def clear_program_caches() -> None:
+    """Drop the cached stage programs (tests that rebuild meshes use this —
+    a NamedSharding over a dead mesh must not satisfy a fresh lookup)."""
+    _ZEROS_PROGRAMS.clear()
+    _SLICE_PROGRAMS.clear()
+    _UPDATE_PROGRAMS.clear()
+
+
+def _staged_leaf(leaf, dst_sharding, leaf_stages, fire: Callable[[Stage], None]):
+    if not leaf_stages:  # identity: re-bind to the (equal-layout) dst sharding
+        return jax.device_put(leaf, dst_sharding)
+    if len(leaf_stages) == 1 and leaf_stages[0].chunk is None:
+        fire(leaf_stages[0])
+        # whole-leaf relayout in one stage: XLA's transfer engine — the ICI
+        # collective the plan's `kind` names, at CPU scale
+        return jax.device_put(leaf, dst_sharding)
+    axis = leaf_stages[0].chunk[0]
+    dest = _alloc_dest(tuple(leaf.shape), leaf.dtype, dst_sharding)
+    for stage in leaf_stages:
+        fire(stage)
+        _, start, size = stage.chunk
+        chunk = _slice_chunk(leaf, axis, start, size)
+        chunk = jax.device_put(chunk, dst_sharding)
+        dest = _commit_chunk(dest, chunk, axis, start, dst_sharding)
+    return dest
+
+
+# ---------------------------------------------------------------------------
+# the transfer transaction
+# ---------------------------------------------------------------------------
+
+_SEQ_LOCK = threading.Lock()
+_TRANSFER_SEQ = 0
+
+
+def _next_seq() -> int:
+    global _TRANSFER_SEQ
+    with _SEQ_LOCK:
+        seq = _TRANSFER_SEQ
+        _TRANSFER_SEQ += 1
+        return seq
+
+
+def reset_transfer_seq() -> None:
+    """Re-zero the process-wide transfer counter the chaos leg indexes
+    (tests/bench arm ``redistribute_fail_at`` against a known sequence)."""
+    global _TRANSFER_SEQ
+    with _SEQ_LOCK:
+        _TRANSFER_SEQ = 0
+
+
+def redistribute(
+    tree: Any,
+    dst_shardings: Any,
+    *,
+    config: Optional[RedistributeConfig] = None,
+    lost_device_ids: "frozenset[int] | set[int]" = frozenset(),
+    buddy_tree: Any = None,
+    fault_plan: Any = None,
+    epoch_fence: Optional[EpochFence] = None,
+    probe: Optional[Callable[[], None]] = None,
+    telemetry: Any = None,
+    trace_id: Optional[str] = None,
+) -> Any:
+    """Redistribute ``tree`` from its live shardings onto ``dst_shardings``
+    and return the NEW tree — the commit. Transactional: the source is never
+    donated and stays valid until the caller drops it; any failure before
+    return leaves it intact.
+
+    ``lost_device_ids`` / ``buddy_tree`` select the host-relay rung (the
+    elastic shrink: survivors-only reads, buddy stitching). ``epoch_fence``
+    (an :class:`EpochFence`) is checked at plan time and again at commit —
+    a zombie's transfer is refused with ``StaleEpochError`` and recorded.
+    ``probe`` is invoked between stages (the caller's own chaos window).
+    ``fault_plan`` defaults to the module-activated chaos plan; its
+    ``redistribute_fail_*`` legs kill a named stage mid-transfer, driving
+    the ladder staged → host relay → fail loud."""
+    from ..resilience import chaos as chaos_mod
+    from ..resilience.membership import StaleEpochError
+
+    config = config or RedistributeConfig()
+    if fault_plan is None:
+        fault_plan = chaos_mod.active_plan()
+    seq = _next_seq()
+    t0 = time.perf_counter()
+    lost = set(lost_device_ids)
+    plan = plan_redistribute(
+        tree, dst_shardings, lost_device_ids=lost, buddy_tree=buddy_tree,
+        config=config,
+    )
+
+    base = {
+        "transfer": seq,
+        "path": plan.rung,
+        "leaves": plan.num_leaves,
+        "stages": len(plan.stages),
+        "stage_kinds": plan.stage_kinds,
+        "bytes_moved": plan.total_bytes,
+        "peak_scratch_bytes": plan.peak_scratch_bytes,
+        "max_scratch_bytes": plan.max_scratch_bytes,
+    }
+    if trace_id is not None:
+        base["trace_id"] = trace_id
+
+    def _emit(outcome: str, **extra) -> None:
+        payload = {
+            **base, "outcome": outcome,
+            "wall_time_s": round(time.perf_counter() - t0, 6), **extra,
+        }
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            telemetry.write_record("redistribute", payload)
+
+    def _fenced(new_tree):
+        """The commit: nothing the caller can observe changes until the
+        fence passes — a refused commit drops the new buffers unreferenced
+        and the source stays live."""
+        if epoch_fence is not None:
+            try:
+                epoch_fence.check()
+            except StaleEpochError:
+                _emit("stale_epoch_write_rejected")
+                raise
+        return new_tree
+
+    def _fire(stage: Stage) -> None:
+        if fault_plan is not None and fault_plan.redistribute_fail(
+            seq, stage.index, stage.kind
+        ):
+            raise RedistributeStageFailure(
+                f"redistribute transfer {seq} lost stage {stage.index} "
+                f"({stage.kind}, leaf {stage.leaf}) mid-transfer",
+                stage=stage.index, kind=stage.kind, leaf=stage.leaf,
+            )
+        if probe is not None:
+            probe()
+
+    if epoch_fence is not None:
+        # plan-time check: a coordinator that is ALREADY fenced out must not
+        # start reading shards it no longer owns
+        try:
+            epoch_fence.check()
+        except StaleEpochError:
+            _emit("stale_epoch_write_rejected")
+            raise
+
+    if plan.rung == "host_relay":
+        if not plan.covered:
+            _emit("failed", error="uncovered")
+            raise RedistributeError(
+                "redistribute: surviving shards do not cover the tree "
+                f"({len(lost)} lost device(s)) — no rung can move state that "
+                "no longer exists; the caller's ladder falls to its next rung"
+            )
+        for stage in plan.stages:
+            _fire(stage)
+        out = _fenced(relay_tree(tree, lost, buddy_tree, dst_shardings))
+        _emit("committed")
+        return out
+
+    # -- staged rung --------------------------------------------------------
+    by_leaf: dict[str, list[Stage]] = {}
+    for stage in plan.stages:
+        by_leaf.setdefault(stage.leaf, []).append(stage)
+    paths = _leaf_paths(tree)
+    leaves = jax.tree.leaves(tree)
+    dst_leaves = jax.tree.leaves(dst_shardings)
+    treedef = jax.tree.structure(tree)
+    try:
+        new_leaves = [
+            _staged_leaf(leaf, dst, by_leaf.get(path, []), _fire)
+            for path, leaf, dst in zip(paths, leaves, dst_leaves)
+        ]
+        out = _fenced(jax.tree.unflatten(treedef, new_leaves))
+        _emit("committed")
+        return out
+    except RedistributeStageFailure as failure:
+        detail = {
+            "failed_stage": failure.stage,
+            "failed_stage_kind": failure.kind,
+            "failed_leaf": failure.leaf,
+        }
+        if config.force_path == "staged":
+            _emit("failed", **detail)
+            raise RedistributeError(
+                f"staged redistribution failed at stage {failure.stage} "
+                f"({failure.kind}, leaf {failure.leaf}) and the host-relay "
+                "fallback is disabled (force_path='staged')"
+            ) from failure
+        # the ladder: the source is intact (never donated) — degrade to the
+        # host relay, re-reading every source shard
+        logger.warning(
+            f"redistribute: stage {failure.stage} ({failure.kind}) failed — "
+            "falling back to the host relay"
+        )
+        out = _fenced(relay_tree(tree, set(), None, dst_shardings))
+        _emit("fell_back", **detail)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the paged-transfer leg (the disagg KV handoff's wire)
+# ---------------------------------------------------------------------------
+
+
+def paged_transfer(
+    extract: Callable[[list], tuple],
+    pages: list,
+    *,
+    fault_plan: Any = None,
+    probe: Optional[Callable[[], None]] = None,
+    telemetry: Any = None,
+    trace_id: Optional[str] = None,
+) -> tuple:
+    """The KV handoff's transfer leg, routed through the redistribution
+    primitive: one stage per parked page (each page's fixed-shape block is
+    the chunk, so the scratch bound is a page — the layout already IS the
+    2112.01075 decomposition). ``extract`` is the source engine's jitted
+    per-page read; the commit (the destination's donated adopt/copy program
+    + ``release_parked`` ack) stays with the router, whose retry-then-
+    re-prefill ladder is this transfer's fallback rung.
+
+    Chaos: the ``redistribute_fail_*`` legs kill a named page-read stage
+    here, and ``probe`` (the router's handoff stall/loss window) fires in
+    the same mid-transfer window as before — the pre-existing drills are
+    inherited unchanged. At CPU scale the page blocks stage through host
+    (the relay rung, recorded honestly); on a pod the same page list drives
+    device-to-device sends."""
+    from ..resilience import chaos as chaos_mod
+    from ..resilience.membership import StaleEpochError  # noqa: F401 - parity
+
+    if fault_plan is None:
+        fault_plan = chaos_mod.active_plan()
+    seq = _next_seq()
+    t0 = time.perf_counter()
+    n = len(pages)
+    if fault_plan is not None:
+        for stage in range(n):
+            if fault_plan.redistribute_fail(seq, stage, "paged_extract"):
+                raise RedistributeStageFailure(
+                    f"redistribute transfer {seq} lost page-read stage "
+                    f"{stage} of {n} mid-transfer",
+                    stage=stage, kind="paged_extract", leaf=f"page[{stage}]",
+                )
+    if probe is not None:
+        probe()
+    k_blocks, v_blocks = extract(pages)
+    moved = int(k_blocks.nbytes + v_blocks.nbytes)
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        payload = {
+            "transfer": seq,
+            "path": "host_relay",
+            "leaves": 2,
+            "stages": n,
+            "stage_kinds": {"paged_extract": n},
+            "bytes_moved": moved,
+            "peak_scratch_bytes": moved // max(n, 1),
+            "max_scratch_bytes": moved // max(n, 1),
+            "outcome": "committed",
+            "wall_time_s": round(time.perf_counter() - t0, 6),
+        }
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
+        telemetry.write_record("redistribute", payload)
+    return k_blocks, v_blocks
+
+
+# ---------------------------------------------------------------------------
+# the canonical contract program (analyze --self-check / tests/contracts)
+# ---------------------------------------------------------------------------
+
+# the contract-recording geometry: a (64, 128) f32 leaf on the 8-way mesh,
+# chunk bound 4 KiB → 8-row chunks — small enough to compile in the CLI gate,
+# chunked enough that the stage program is the REAL multi-chunk commit path
+CONTRACT_SHAPE = (64, 128)
+CONTRACT_CHUNK_ROWS = 8
+
+
+def canonical_redistribute_program():
+    """The chunk-commit stage program the ``redistribute_stage`` contract is
+    recorded from, lowered over the full device mesh. Returns ``(lowered,
+    hbm_budget_bytes)``: the budget arms the PR 8 memory audit's
+    ``HBM_OVER_BUDGET`` gate at destination + chunk (+ slack for XLA
+    bookkeeping) — the scratch bound checked, not claimed."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices.reshape(-1), ("x",))
+    dst = NamedSharding(mesh, PartitionSpec(None, "x"))
+    dest = jax.ShapeDtypeStruct(CONTRACT_SHAPE, jnp.float32, sharding=dst)
+    chunk = jax.ShapeDtypeStruct(
+        (CONTRACT_CHUNK_ROWS, CONTRACT_SHAPE[1]), jnp.float32, sharding=dst
+    )
+    start = jax.ShapeDtypeStruct((), jnp.int32)
+    lowered = jax.jit(
+        _update_fn(0), donate_argnums=(0,), out_shardings=dst
+    ).lower(dest, chunk, start)
+    dest_bytes = int(np.prod(CONTRACT_SHAPE)) * 4
+    chunk_bytes = CONTRACT_CHUNK_ROWS * CONTRACT_SHAPE[1] * 4
+    # donation aliases dest in/out, so audited peak ≈ chunk (+ index + code);
+    # 2× chunk headroom keeps the gate about the BOUND, not XLA's mood
+    budget = dest_bytes + 2 * chunk_bytes
+    return lowered, budget

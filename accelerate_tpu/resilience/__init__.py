@@ -37,6 +37,7 @@ from .hub import Resilience, ResilienceConfig
 from .membership import (
     STORE_RETRY,
     CollectiveHangWatchdog,
+    DictStore,
     FilesystemStore,
     MembershipConfig,
     MembershipService,
@@ -63,6 +64,7 @@ __all__ = [
     "ElasticCoordinator",
     "ElasticFailure",
     "FaultPlan",
+    "DictStore",
     "FilesystemStore",
     "MembershipConfig",
     "MembershipService",

@@ -55,7 +55,14 @@ Fault legs:
   timeout), a lost one raises :class:`~..serving.fleet.HandoffLost` as if
   the source's blocks vanished mid-read. Both must be absorbed by the
   router's retry-then-re-prefill ladder without stranding or duplicating a
-  request.
+  request;
+- ``redistribute_fail_at`` / ``redistribute_fail_stage`` — the
+  redistribution drill (parallel/redistribute.py): kill stage
+  ``redistribute_fail_stage`` of the chosen redistribute *transfers*
+  (0-based, process-wide transfer sequence — elastic relays, regrows, and
+  KV-handoff page transfers all count) mid-transfer. The primitive's ladder
+  must degrade staged → host relay with the source intact, or fail loud
+  NAMING the stage when the fallback is pinned off.
 
 Activation: pass a plan to ``ResilienceConfig(fault_plan=...)`` /
 ``ServingEngine(fault_plan=...)``, or export ``ACCELERATE_CHAOS_*`` (see
@@ -127,6 +134,11 @@ class FaultPlan:
     # first failure AND its retry)
     handoff_stall_at: tuple[int, ...] = ()
     handoff_loss_at: tuple[int, ...] = ()
+    # redistribution faults: indices count redistribute TRANSFERS (0-based,
+    # process-wide — parallel/redistribute.py's sequence counter); the stage
+    # index selects WHICH stage of the decomposition dies mid-transfer
+    redistribute_fail_at: tuple[int, ...] = ()
+    redistribute_fail_stage: int = 0
 
     # ledger of injected faults (appended in firing order); ``sink`` is set by
     # the resilience hub so every injection also lands in telemetry.jsonl
@@ -185,6 +197,12 @@ class FaultPlan:
             ),
             handoff_stall_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_STALL_AT")),
             handoff_loss_at=_parse_steps(env.get("ACCELERATE_CHAOS_HANDOFF_LOSS_AT")),
+            redistribute_fail_at=_parse_steps(
+                env.get("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_AT")
+            ),
+            redistribute_fail_stage=int(
+                env.get("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_STAGE", "0")
+            ),
         )
 
     @property
@@ -203,6 +221,7 @@ class FaultPlan:
             or self.membership_stall_step is not None
             or self.handoff_stall_at
             or self.handoff_loss_at
+            or self.redistribute_fail_at
         )
 
     def _record(self, fault: str, **detail) -> None:
@@ -359,6 +378,20 @@ class FaultPlan:
         have returned)."""
         if attempt in self.handoff_loss_at:
             self._record("handoff_loss", attempt=attempt)
+            return True
+        return False
+
+    def redistribute_fail(self, transfer: int, stage: int, kind: str) -> bool:
+        """Whether stage ``stage`` of redistribute transfer ``transfer``
+        dies mid-transfer (parallel/redistribute.py raises a
+        ``RedistributeStageFailure`` where the stage would have run — the
+        primitive's ladder, not this hook, decides what happens next). The
+        ledger names the stage and its collective ``kind``, so the drill's
+        telemetry pins WHICH stage of the decomposition was killed."""
+        if transfer in self.redistribute_fail_at and stage == self.redistribute_fail_stage:
+            self._record(
+                "redistribute_fail", transfer=transfer, stage=stage, kind=kind
+            )
             return True
         return False
 

@@ -169,112 +169,20 @@ def buddy_shardings(shardings: Any, bmesh: jax.sharding.Mesh) -> Any:
 
 # ---------------------------------------------------------------------------
 # survivor-side reassembly (the honest read path: lost devices are unreadable)
+#
+# These primitives moved to parallel/redistribute.py — the coverage pre-check
+# and the per-leaf host relay ARE the fallback rung of the one redistribution
+# primitive every recovery path now routes through — and are re-exported here
+# so the ladder's callers (and the drills) keep their import path.
 # ---------------------------------------------------------------------------
 
-
-def _index_key(index: tuple, shape: tuple) -> tuple:
-    """Normalize a shard's global-slice index so primary and buddy shards of
-    the same region compare equal (None-bounded slices vs explicit ones)."""
-    out = []
-    for sl, dim in zip(index, shape):
-        start = 0 if sl.start is None else int(sl.start)
-        stop = dim if sl.stop is None else int(sl.stop)
-        out.append((start, stop))
-    return tuple(out)
-
-
-def assemble_from_survivors(
-    primary: jax.Array,
-    lost_ids: "set[int]",
-    buddy: Optional[jax.Array] = None,
-) -> Optional[np.ndarray]:
-    """Reassemble one global array on host from shards on SURVIVING devices
-    only — the elastic read primitive. Shards whose device id is in
-    ``lost_ids`` are never touched (the simulation's honesty guarantee: a
-    dead host's HBM is unreadable). Missing regions are filled from the
-    ``buddy`` copy's surviving shards; returns None when coverage is still
-    incomplete (primary and buddy both lost — the caller's ladder falls
-    through to the next rung)."""
-    shape = tuple(primary.shape)
-    out = np.empty(shape, dtype=primary.dtype)
-    needed = {
-        _index_key(idx, shape)
-        for idx in primary.sharding.devices_indices_map(shape).values()
-    }
-    have: set = set()
-    for source in (primary, buddy):
-        if source is None:
-            continue
-        for shard in source.addressable_shards:
-            if shard.device.id in lost_ids:
-                continue
-            key = _index_key(shard.index, shape)
-            if key in have:
-                continue
-            out[shard.index] = np.asarray(shard.data)
-            have.add(key)
-        if needed <= have:
-            return out
-    return None
-
-
-def _leaf_covered(primary: jax.Array, lost_ids: "set[int]", buddy=None) -> bool:
-    """Coverage pre-check WITHOUT reading any shard data: do the surviving
-    (primary ∪ buddy) shards tile the whole array? Walks sharding metadata
-    only, so the ladder can decide its rung before moving a byte."""
-    shape = tuple(primary.shape)
-    needed = {
-        _index_key(idx, shape)
-        for idx in primary.sharding.devices_indices_map(shape).values()
-    }
-    have: set = set()
-    for source in (primary, buddy):
-        if source is None:
-            continue
-        for device, idx in source.sharding.devices_indices_map(shape).items():
-            if device.id not in lost_ids:
-                have.add(_index_key(idx, shape))
-    return needed <= have
-
-
-def tree_covered(primary_tree: Any, lost_ids: "set[int]", buddy_tree: Any = None) -> bool:
-    """Whether every leaf of the tree survives the loss (metadata-only)."""
-    if buddy_tree is None:
-        flags = jax.tree.map(lambda p: _leaf_covered(p, lost_ids), primary_tree)
-    else:
-        flags = jax.tree.map(
-            lambda p, b: _leaf_covered(p, lost_ids, b), primary_tree, buddy_tree
-        )
-    return all(jax.tree.leaves(flags))
-
-
-def relay_tree(
-    primary_tree: Any,
-    lost_ids: "set[int]",
-    buddy_tree: Any,
-    new_shardings: Any,
-) -> Any:
-    """Relay a state tree onto a new mesh through surviving shards, ONE LEAF
-    AT A TIME: assemble the leaf on host, ``device_put`` it to its new
-    sharding, drop the host copy — peak host memory is bounded by the
-    largest leaf, never the whole state (the CPU analogue of 2112.01075's
-    no-full-buffer redistribution). Callers pre-check :func:`tree_covered`;
-    an uncovered leaf here is a programming error and raises."""
-
-    def _leaf(p, b, s):
-        host = assemble_from_survivors(p, lost_ids, b)
-        if host is None:
-            raise ElasticFailure(
-                "internal: relay_tree called for a leaf whose surviving "
-                "shards do not cover it (coverage must be checked first)"
-            )
-        return jax.device_put(host, s)
-
-    if buddy_tree is None:
-        return jax.tree.map(
-            lambda p, s: _leaf(p, None, s), primary_tree, new_shardings
-        )
-    return jax.tree.map(_leaf, primary_tree, buddy_tree, new_shardings)
+from ..parallel.redistribute import (  # noqa: E402,F401 - re-exported API
+    _index_key,
+    _leaf_covered,
+    assemble_from_survivors,
+    relay_tree,
+    tree_covered,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -1004,20 +912,40 @@ class ElasticCoordinator:
 
     def _relay_state(self, lost_ids: set, buddy: Optional[dict], scaler_host) -> None:
         """Move params + optimizer state from the (old-mesh) surviving shards
-        onto the freshly derived layouts, one leaf at a time."""
+        onto the freshly derived layouts through the redistribution primitive
+        (parallel/redistribute.py). The plan decides the rung before a byte
+        moves: a shrink (lost devices / buddy merge) takes the host-relay
+        rung — survivors-only reads, exactly the old per-leaf relay — while
+        ``regrow``'s pure relayout (nothing lost) takes the staged path with
+        bounded per-chip scratch. The commit is epoch-fenced when membership
+        is attached: a zombie coordinator's relay is refused, never applied."""
+        from ..parallel.redistribute import EpochFence, redistribute
         from ..parallel.sharding import replicated
 
-        self.model.params = relay_tree(
-            self.model.params,
-            lost_ids,
-            buddy["params"] if buddy else None,
-            self.model.params_shardings,
+        fence = None
+        if self.membership is not None:
+            fence = EpochFence(self.membership.store, self.membership.epoch)
+        fault_plan = getattr(
+            getattr(self.accelerator, "resilience", None), "chaos", None
         )
-        self.optimizer.opt_state = relay_tree(
+        telemetry = getattr(self.accelerator, "telemetry", None)
+        self.model.params = redistribute(
+            self.model.params,
+            self.model.params_shardings,
+            lost_device_ids=lost_ids,
+            buddy_tree=buddy["params"] if buddy else None,
+            fault_plan=fault_plan,
+            epoch_fence=fence,
+            telemetry=telemetry,
+        )
+        self.optimizer.opt_state = redistribute(
             self.optimizer.opt_state,
-            lost_ids,
-            buddy["opt_state"] if buddy else None,
             self.optimizer._opt_state_device_shardings,
+            lost_device_ids=lost_ids,
+            buddy_tree=buddy["opt_state"] if buddy else None,
+            fault_plan=fault_plan,
+            epoch_fence=fence,
+            telemetry=telemetry,
         )
         if scaler_host is not None:
             rep = replicated(self.mesh)

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -209,6 +210,74 @@ class FilesystemStore(MembershipStore):
 
     def delete(self, key: str) -> None:
         self._retry.call(self._delete_op, key)
+
+
+class DictStore(MembershipStore):
+    """In-memory store with REAL compare-and-swap fenced operations — the
+    overridable API shape the ROADMAP's GCS/etcd backend drops into, proven
+    here: ``fenced_write`` and ``mint_epoch`` hold one lock across the
+    read-check-write, so two racing minters serialize and exactly one wins
+    (the base class's unlocked read-check-write only gets that from the
+    caller's retry loop; a transactional backend gets it from the store —
+    this class IS that contract, minus the network). Records round-trip
+    through JSON so a payload that would not survive a real backend
+    (non-serializable values, mutation after write) fails here too.
+
+    Process-local by construction: the right backend for single-process
+    tests and drills, never for a real multi-host pod."""
+
+    def __init__(self):
+        self._data: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def read(self, key: str) -> Optional[dict]:
+        probe_io("membership_store")
+        with self._lock:
+            raw = self._data.get(key)
+        return None if raw is None else json.loads(raw)
+
+    def write(self, key: str, payload: dict) -> None:
+        probe_io("membership_store")
+        raw = json.dumps(payload)
+        with self._lock:
+            self._data[key] = raw
+
+    def list(self, prefix: str) -> dict[str, dict]:
+        probe_io("membership_store")
+        with self._lock:
+            items = [
+                (k, raw) for k, raw in self._data.items()
+                if k.startswith(prefix + "/")
+            ]
+        return {k: json.loads(raw) for k, raw in sorted(items)}
+
+    def delete(self, key: str) -> None:
+        probe_io("membership_store")
+        with self._lock:
+            self._data.pop(key, None)
+
+    # -- the CAS overrides: read-check-write under ONE lock -----------------
+
+    def fenced_write(self, key: str, payload: dict, epoch: int) -> None:
+        probe_io("membership_store")
+        raw = json.dumps(payload)
+        with self._lock:
+            current = self._data.get(EPOCH_KEY)
+            if current is not None:
+                have = int(json.loads(current).get("epoch", 0))
+                if have > int(epoch):
+                    raise StaleEpochError(key, int(epoch), have)
+            self._data[key] = raw
+
+    def mint_epoch(self, record: dict, expected: Optional[int]) -> None:
+        probe_io("membership_store")
+        raw = json.dumps(record)
+        with self._lock:
+            current = self._data.get(EPOCH_KEY)
+            have = int(json.loads(current).get("epoch", 0)) if current is not None else 0
+            if expected is not None and have != int(expected):
+                raise StaleEpochError(EPOCH_KEY, int(expected), have)
+            self._data[EPOCH_KEY] = raw
 
 
 def publish_supervisor_loss(store: "MembershipStore | str", host: int, reason: str = "") -> None:

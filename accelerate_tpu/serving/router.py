@@ -750,7 +750,7 @@ class ServingRouter:
                     )
                 moved_bytes = 0
             else:
-                kb, vb = self._transfer_blocks(src, pages, seq)
+                kb, vb = self._transfer_blocks(src, pages, seq, request_id=rr.id)
                 if (
                     self.handoff_timeout_s is not None
                     and time.perf_counter() - t0 > self.handoff_timeout_s
@@ -850,18 +850,45 @@ class ServingRouter:
         )
         return True
 
-    def _transfer_blocks(self, src: EngineReplica, pages, attempt_seq: int):
-        """The wire: read the parked pages' fixed-shape blocks off the
-        source. Chaos rides HERE — mid-transfer, between deciding to move
-        and the destination adopting — so the stall/loss drills exercise
-        exactly the window where a real interconnect fails."""
-        if self.chaos is not None:
-            stall = self.chaos.handoff_stall(attempt_seq)
-            if stall:
-                time.sleep(stall)
-            if self.chaos.handoff_loss(attempt_seq):
-                raise HandoffLost("chaos: source blocks lost mid-transfer")
-        return src.engine.extract_pages(pages)
+    def _transfer_blocks(
+        self, src: EngineReplica, pages, attempt_seq: int, request_id=None
+    ):
+        """The wire, routed through the redistribution primitive
+        (:func:`~..parallel.redistribute.paged_transfer`): one stage per
+        parked page, the page block as the scratch-bounded chunk, one
+        ``{"kind": "redistribute"}`` record per transfer carrying the
+        request's ``trace_id``. Chaos rides in the probe — mid-transfer,
+        between deciding to move and the destination adopting — so the
+        stall/loss drills exercise exactly the window where a real
+        interconnect fails, and the primitive's ``redistribute_fail_*`` legs
+        kill a named page-read stage in the same window. A killed stage
+        surfaces as :class:`~.fleet.HandoffLost` naming the stage: the
+        handoff's retry-then-re-prefill ladder IS this transfer's fallback
+        rung, and the parked source pages stay refcounted throughout."""
+        from ..parallel.redistribute import RedistributeStageFailure, paged_transfer
+
+        def _probe() -> None:
+            if self.chaos is not None:
+                stall = self.chaos.handoff_stall(attempt_seq)
+                if stall:
+                    time.sleep(stall)
+                if self.chaos.handoff_loss(attempt_seq):
+                    raise HandoffLost("chaos: source blocks lost mid-transfer")
+
+        try:
+            return paged_transfer(
+                src.engine.extract_pages,
+                pages,
+                fault_plan=self.chaos,
+                probe=_probe,
+                telemetry=self.telemetry,
+                trace_id=request_id,
+            )
+        except RedistributeStageFailure as failure:
+            raise HandoffLost(
+                f"redistribute stage {failure.stage} ({failure.kind}) lost "
+                "mid-transfer"
+            ) from failure
 
     def _drop_parked(self, rr: RoutedRequest) -> None:
         """Release a pending request's parked source pages (terminal from

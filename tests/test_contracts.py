@@ -58,6 +58,10 @@ REQUIRED_CONTRACTS = {
     "serving_adopt_kv",
     "bert_base_step",
     "llama_125m_fsdp_step",
+    # ISSUE 16: the redistribution primitive's chunk-commit stage program —
+    # destination donated (one chunk in flight), peak HBM gated against the
+    # scratch-bound-derived budget, no baked constants
+    "redistribute_stage",
 }
 
 

@@ -974,3 +974,25 @@ def test_coordinator_hang_watchdog_reports_wedged_step(tmp_path):
     records = _records(str(tmp_path / "telemetry_hang"), "membership")
     assert any(r["event"] == "collective_hang_suspected" for r in records)
     assert any(r["event"] == "collective_hang_cleared" for r in records)
+
+
+def test_dictstore_is_a_dropin_membership_backend():
+    """The in-memory CAS store (ISSUE 16) carries a full membership
+    lifecycle — heartbeats, loss resolution, zombie fencing, re-admission —
+    identically to FilesystemStore: nothing above the store changes."""
+    from accelerate_tpu import DictStore
+
+    store = DictStore()
+    a = MembershipService(store, num_hosts=2, host_index=0)
+    b = MembershipService(store, num_hosts=2, host_index=1)
+    assert a.heartbeat(1) and b.heartbeat(1)
+    assert a.resolve_loss(1) == 2
+    # the zombie's stale write is refused by the real CAS, not a race
+    assert not b.heartbeat(2)
+    assert b.stale_writes_rejected == 1
+    with pytest.raises(StaleEpochError):
+        store.fenced_write("hosts/1", {"host": 1}, epoch=1)
+    b.announce_join()
+    assert a.pending_joins() == [1]
+    assert a.admit(1) == 3
+    assert b.heartbeat(2) and b.epoch == 3

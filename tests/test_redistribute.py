@@ -1,0 +1,421 @@
+"""The redistribution primitive (parallel/redistribute.py; ISSUE 16).
+
+The claims this file pins, each as a measured property rather than prose:
+
+- **Round-trip bit-equality** (property-style) — random leaf shapes/dtypes
+  redistributed across random mesh-pair factorings (reshard, permute,
+  shrink-shaped half-mesh pairs, disjoint-device pairs) come back bit-exact
+  against both the source values and the host-relay reference, on every
+  rung; a plan whose leaves exceed ``max_scratch_bytes`` chunks them so no
+  stage stages more than the bound.
+- **The plan decides before a byte moves** — rung selection (staged for a
+  pure relayout, host-relay for lost devices or a buddy merge) and the
+  coverage verdict are metadata-only, and the collective kinds
+  (``collective_permute`` / ``all_to_all`` / ``device_put``) match the
+  sharding geometry.
+- **Transaction + chaos ladder** — a chaos-killed stage
+  (``redistribute_fail_at/_stage``, ``ACCELERATE_CHAOS_REDISTRIBUTE_*``)
+  never corrupts the source: the ladder degrades staged → host relay with a
+  bit-exact result and a ``fell_back`` telemetry outcome, or fails loud
+  NAMING the stage when the fallback is pinned off.
+- **Epoch-fenced commit** — a transfer planned under epoch N whose store
+  moves to N+1 mid-flight is refused AT COMMIT (``StaleEpochError``),
+  recorded ``stale_epoch_write_rejected``, source intact.
+- **The handoff wire** — ``paged_transfer`` fires the probe (the router's
+  chaos window) mid-transfer and a killed page-read stage raises before any
+  block is returned.
+- **The CAS store** — ``DictStore``'s ``fenced_write``/``mint_epoch`` are a
+  real compare-and-swap (threaded mint race: exactly one winner), behavior-
+  matched against ``FilesystemStore``'s read-check-write.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.parallel.redistribute import (
+    EpochFence,
+    RedistributeConfig,
+    RedistributeError,
+    RedistributeStageFailure,
+    assemble_from_survivors,
+    paged_transfer,
+    plan_redistribute,
+    redistribute,
+    relay_tree,
+    reset_transfer_seq,
+    tree_covered,
+)
+from accelerate_tpu.resilience.chaos import FaultPlan
+from accelerate_tpu.resilience.membership import (
+    EPOCH_KEY,
+    DictStore,
+    FilesystemStore,
+    StaleEpochError,
+)
+
+
+def _devices():
+    return np.asarray(jax.devices())
+
+
+def _mesh(shape, axes, devices=None):
+    devs = _devices() if devices is None else np.asarray(devices)
+    return Mesh(devs[: int(np.prod(shape))].reshape(shape), axes)
+
+
+class _Sink:
+    """Minimal telemetry double: captures write_record payloads."""
+
+    enabled = True
+
+    def __init__(self):
+        self.records = []
+
+    def write_record(self, kind, payload):
+        self.records.append({"kind": kind, **payload})
+        return self.records[-1]
+
+
+def _tree_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# -- round-trip property: random shapes/dtypes × random mesh pairs -------------
+
+
+def test_roundtrip_random_shapes_meshes_bit_exact():
+    """The property sweep: every (leaf set, mesh pair, spec pair) sampled
+    must redistribute bit-exact on the staged rung, match the host-relay
+    reference exactly (the tolerance-0 gate pinning staged == relay), and
+    respect the scratch bound in the plan."""
+    devs = _devices()
+    rng = np.random.default_rng(0)
+    mesh_pairs = [
+        # reshard: same devices, different factoring
+        (_mesh((4, 2), ("x", "y")), _mesh((2, 4), ("x", "y"))),
+        # permute: same mesh shape over a rolled device order
+        (
+            _mesh((8,), ("x",)),
+            Mesh(np.roll(devs, 3).reshape(8), ("x",)),
+        ),
+        # shrink-shaped: full mesh down to the first half
+        (_mesh((8,), ("x",)), _mesh((4,), ("x",), devs[:4])),
+        # regrow-shaped: half mesh back to the full mesh
+        (_mesh((4,), ("x",), devs[:4]), _mesh((8,), ("x",))),
+        # disjoint halves: no shared device at all
+        (_mesh((4,), ("x",), devs[:4]), _mesh((4,), ("x",), devs[4:])),
+    ]
+    dtypes = [np.float32, np.int32, jnp.bfloat16]
+    for trial, (mesh_a, mesh_b) in enumerate(mesh_pairs):
+        specs_a = [P("x"), P(None), P(None, "x") if len(mesh_a.shape) == 1 else P("y", "x")]
+        specs_b = [P(None), P("x"), P("x", None)]
+        tree = {}
+        dst = {}
+        for i in range(3):
+            dims = rng.integers(1, 3 + 1)
+            # multiples of 8 so every factoring divides; +1-d leaves mix in
+            shape = tuple(int(8 * rng.integers(1, 5)) for _ in range(dims))
+            dtype = dtypes[int(rng.integers(0, len(dtypes)))]
+            value = rng.standard_normal(shape).astype(dtype)
+            spec_a = specs_a[int(rng.integers(0, len(specs_a)))]
+            spec_b = specs_b[int(rng.integers(0, len(specs_b)))]
+            # clip specs to the leaf's rank
+            spec_a = P(*spec_a[: len(shape)])
+            spec_b = P(*spec_b[: len(shape)])
+            tree[f"leaf{i}"] = jax.device_put(value, NamedSharding(mesh_a, spec_a))
+            dst[f"leaf{i}"] = NamedSharding(mesh_b, spec_b)
+        config = RedistributeConfig(max_scratch_bytes=512)  # force chunking
+        plan = plan_redistribute(tree, dst, config=config)
+        assert plan.rung == "staged", trial
+        for stage in plan.stages:
+            # every chunked stage respects the bound unless it is the
+            # unchunkable floor: one slab per destination partition of the
+            # axis (at most one row per device on the 8-way simulation)
+            if stage.chunk is not None and stage.chunk[2] > len(jax.devices()):
+                assert stage.nbytes <= config.max_scratch_bytes, (trial, stage)
+        assert plan.peak_scratch_bytes == max(
+            (s.nbytes for s in plan.stages), default=0
+        )
+        out = redistribute(tree, dst, config=config)
+        ref = relay_tree(tree, set(), None, dst)
+        for key in tree:
+            assert np.array_equal(np.asarray(out[key]), np.asarray(tree[key])), (trial, key)
+            assert np.array_equal(np.asarray(out[key]), np.asarray(ref[key])), (trial, key)
+            assert out[key].sharding == dst[key], (trial, key)
+
+
+def test_plan_is_metadata_only_and_kinds_match_geometry():
+    devs = _devices()
+    mesh = _mesh((8,), ("x",))
+    rolled = Mesh(np.roll(devs, 1).reshape(8), ("x",))
+    half_a = _mesh((4,), ("x",), devs[:4])
+    half_b = _mesh((4,), ("x",), devs[4:])
+    x = jax.device_put(np.arange(64, dtype=np.float32), NamedSharding(mesh, P("x")))
+    h = jax.device_put(np.arange(32, dtype=np.float32), NamedSharding(half_a, P("x")))
+    plan = plan_redistribute(
+        {"permute": x, "reshard": x, "cross": h},
+        {
+            "permute": NamedSharding(rolled, P("x")),  # same tiling, new owners
+            "reshard": NamedSharding(mesh, P(None)),  # tiling changes
+            "cross": NamedSharding(half_b, P("x")),  # disjoint devices
+        },
+    )
+    assert plan.stage_kinds == {
+        "collective_permute": 1, "all_to_all": 1, "device_put": 1,
+    }
+    # identity leaves plan zero stages
+    plan_id = plan_redistribute({"x": x}, {"x": NamedSharding(mesh, P("x"))})
+    assert plan_id.rung == "staged" and len(plan_id.stages) == 0
+
+
+def test_rung_decision_lost_devices_and_buddy_force_relay():
+    devs = _devices()
+    mesh = _mesh((8,), ("x",))
+    x = jax.device_put(np.arange(64, dtype=np.float32), NamedSharding(mesh, P("x")))
+    dst = {"x": NamedSharding(_mesh((4,), ("x",), devs[:4]), P("x"))}
+    plan = plan_redistribute({"x": x}, dst, lost_device_ids={devs[7].id})
+    assert plan.rung == "host_relay"
+    assert not plan.covered  # a lost shard with no buddy does not cover
+    plan2 = plan_redistribute({"x": x}, dst, buddy_tree={"x": x})
+    assert plan2.rung == "host_relay" and plan2.covered
+    # and redistribute() on an uncovered plan fails loud, before moving bytes
+    with pytest.raises(RedistributeError, match="do not cover|no rung"):
+        redistribute({"x": x}, dst, lost_device_ids={devs[7].id})
+
+
+def test_shrink_path_matches_legacy_relay_bit_exact():
+    """The elastic shrink shape: replicated buddy covers a lost shard; the
+    primitive's relay rung must equal relay_tree exactly (it IS relay_tree,
+    behind the plan step)."""
+    devs = _devices()
+    mesh = _mesh((8,), ("x",))
+    rolled = Mesh(np.roll(devs, 1).reshape(8), ("x",))
+    value = np.arange(128, dtype=np.float32)
+    primary = jax.device_put(value, NamedSharding(mesh, P("x")))
+    buddy = jax.device_put(value, NamedSharding(rolled, P("x")))
+    lost = {devs[0].id}
+    survivors = _mesh((4,), ("x",), devs[4:])
+    dst = NamedSharding(survivors, P("x"))
+    assert tree_covered([primary], lost, [buddy])
+    out = redistribute(
+        [primary], [dst], lost_device_ids=lost, buddy_tree=[buddy]
+    )
+    ref = relay_tree([primary], lost, [buddy], [dst])
+    assert np.array_equal(np.asarray(out[0]), value)
+    assert np.array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+
+
+# -- transaction + chaos ladder ------------------------------------------------
+
+
+def test_chaos_killed_stage_falls_back_source_intact_telemetry_recorded():
+    reset_transfer_seq()
+    mesh_a = _mesh((4, 2), ("x", "y"))
+    mesh_b = _mesh((2, 4), ("x", "y"))
+    value = np.random.default_rng(1).standard_normal((64, 32)).astype(np.float32)
+    tree = {"w": jax.device_put(value, NamedSharding(mesh_a, P("x", "y")))}
+    dst = {"w": NamedSharding(mesh_b, P("y", None))}
+    config = RedistributeConfig(max_scratch_bytes=1024)
+    plan = FaultPlan(redistribute_fail_at=(0,), redistribute_fail_stage=2)
+    sink = _Sink()
+    out = redistribute(tree, dst, config=config, fault_plan=plan, telemetry=sink)
+    # ladder ran staged → host relay; the source was never corrupted
+    assert np.array_equal(np.asarray(tree["w"]), value)
+    assert np.array_equal(np.asarray(out["w"]), value)
+    assert out["w"].sharding == dst["w"]
+    [record] = sink.records
+    assert record["kind"] == "redistribute"
+    assert record["outcome"] == "fell_back"
+    assert record["failed_stage"] == 2
+    assert record["failed_stage_kind"] == "all_to_all"
+    assert record["path"] == "staged"
+    # the chaos ledger names the stage it killed
+    assert plan.events and plan.events[0]["fault"] == "redistribute_fail"
+    assert plan.events[0]["stage"] == 2
+
+
+def test_forced_staged_chaos_fails_loud_naming_the_stage():
+    reset_transfer_seq()
+    mesh_a = _mesh((8,), ("x",))
+    tree = {"w": jax.device_put(np.zeros(64, np.float32), NamedSharding(mesh_a, P("x")))}
+    dst = {"w": NamedSharding(mesh_a, P(None))}
+    plan = FaultPlan(redistribute_fail_at=(0,), redistribute_fail_stage=0)
+    sink = _Sink()
+    with pytest.raises(RedistributeError, match="stage 0"):
+        redistribute(
+            tree, dst, config=RedistributeConfig(force_path="staged"),
+            fault_plan=plan, telemetry=sink,
+        )
+    assert sink.records[-1]["outcome"] == "failed"
+    assert sink.records[-1]["failed_stage"] == 0
+
+
+def test_chaos_env_vars_arm_redistribute_legs(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_AT", "0,3")
+    monkeypatch.setenv("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_STAGE", "2")
+    plan = FaultPlan.from_env()
+    assert plan.redistribute_fail_at == (0, 3)
+    assert plan.redistribute_fail_stage == 2
+    assert plan.active
+
+
+def test_steady_state_transfer_compiles_nothing_second_time():
+    from accelerate_tpu.telemetry.compile_tracker import CompileTracker
+
+    mesh_a = _mesh((4, 2), ("x", "y"))
+    mesh_b = _mesh((2, 4), ("x", "y"))
+    value = np.random.default_rng(2).standard_normal((64, 32)).astype(np.float32)
+    tree = {"w": jax.device_put(value, NamedSharding(mesh_a, P("x", "y")))}
+    dst = {"w": NamedSharding(mesh_b, P("y", None))}
+    config = RedistributeConfig(max_scratch_bytes=1024)
+    redistribute(tree, dst, config=config)  # warm the program caches
+    tracker = CompileTracker().start()
+    out = redistribute(tree, dst, config=config)
+    assert tracker.compile_count == 0, "steady-state redistribute recompiled"
+    assert np.array_equal(np.asarray(out["w"]), value)
+
+
+# -- epoch-fenced commit -------------------------------------------------------
+
+
+def test_zombie_transfer_refused_at_commit_and_recorded():
+    store = DictStore()
+    store.write(EPOCH_KEY, {"epoch": 3, "members": [0, 1]})
+    mesh = _mesh((8,), ("x",))
+    value = np.arange(64, dtype=np.float32)
+    tree = {"w": jax.device_put(value, NamedSharding(mesh, P("x")))}
+    dst = {"w": NamedSharding(mesh, P(None))}
+    fence = EpochFence(store, epoch=3)
+    sink = _Sink()
+
+    # the epoch moves WHILE the transfer is in flight (probe = mid-stage)
+    def _move_epoch():
+        store.write(EPOCH_KEY, {"epoch": 4, "members": [1]})
+
+    with pytest.raises(StaleEpochError):
+        redistribute(
+            tree, dst, epoch_fence=fence, probe=_move_epoch, telemetry=sink
+        )
+    assert sink.records[-1]["outcome"] == "stale_epoch_write_rejected"
+    # source untouched by the refused commit
+    assert np.array_equal(np.asarray(tree["w"]), value)
+    # a fence at the CURRENT epoch commits fine
+    out = redistribute(tree, dst, epoch_fence=EpochFence(store, epoch=4))
+    assert np.array_equal(np.asarray(out["w"]), value)
+
+
+# -- the handoff wire ----------------------------------------------------------
+
+
+def test_paged_transfer_probe_fires_and_chaos_kills_named_stage():
+    reset_transfer_seq()
+    fired = []
+
+    def extract(pages):
+        k = np.zeros((len(pages), 2, 4, 2, 8), np.float32)
+        return k, k.copy()
+
+    kb, vb = paged_transfer(
+        extract, [0, 1, 2], probe=lambda: fired.append(True), fault_plan=None,
+    )
+    assert fired and kb.shape[0] == 3
+    reset_transfer_seq()
+    plan = FaultPlan(redistribute_fail_at=(0,), redistribute_fail_stage=1)
+    with pytest.raises(RedistributeStageFailure, match="stage 1"):
+        paged_transfer(extract, [0, 1, 2], fault_plan=plan)
+    assert plan.events[0]["fault"] == "redistribute_fail"
+
+
+def test_paged_transfer_telemetry_carries_trace_id():
+    reset_transfer_seq()
+
+    def extract(pages):
+        k = np.zeros((len(pages), 2, 4, 2, 8), np.float32)
+        return k, k
+
+    sink = _Sink()
+    paged_transfer(extract, [0, 1], telemetry=sink, trace_id="req-42")
+    [record] = sink.records
+    assert record["kind"] == "redistribute"
+    assert record["trace_id"] == "req-42"
+    assert record["stages"] == 2
+    assert record["outcome"] == "committed"
+    assert record["bytes_moved"] > 0
+
+
+# -- elastic re-exports keep their import path ---------------------------------
+
+
+def test_elastic_reexports_are_the_primitive():
+    from accelerate_tpu.resilience import elastic
+
+    assert elastic.relay_tree is relay_tree
+    assert elastic.tree_covered is tree_covered
+    assert elastic.assemble_from_survivors is assemble_from_survivors
+
+
+# -- the CAS store (satellite) -------------------------------------------------
+
+
+def test_dictstore_roundtrip_matches_filesystem(tmp_path):
+    for store in (DictStore(), FilesystemStore(str(tmp_path))):
+        store.write("hosts/0", {"beat": 1})
+        store.write("hosts/1", {"beat": 2})
+        assert store.read("hosts/0") == {"beat": 1}
+        assert store.read("missing") is None
+        assert store.list("hosts") == {"hosts/0": {"beat": 1}, "hosts/1": {"beat": 2}}
+        store.delete("hosts/0")
+        assert store.read("hosts/0") is None
+        store.delete("hosts/0")  # idempotent
+
+
+def test_dictstore_cas_semantics_match_filesystem(tmp_path):
+    """The fenced API behaves identically across backends: stale fenced
+    writes refused, mint with wrong expectation refused, mint with the right
+    expectation advances — the drop-in contract a GCS/etcd backend needs."""
+    for store in (DictStore(), FilesystemStore(str(tmp_path))):
+        store.write(EPOCH_KEY, {"epoch": 2, "members": [0, 1]})
+        with pytest.raises(StaleEpochError):
+            store.fenced_write("hosts/0", {"beat": 1}, epoch=1)
+        store.fenced_write("hosts/0", {"beat": 1}, epoch=2)  # current: fine
+        with pytest.raises(StaleEpochError):
+            store.mint_epoch({"epoch": 9, "members": [0]}, expected=1)
+        store.mint_epoch({"epoch": 3, "members": [0]}, expected=2)
+        assert store.read(EPOCH_KEY)["epoch"] == 3
+
+
+def test_dictstore_mint_race_exactly_one_winner():
+    """Real CAS: N threads race the same expected-epoch mint; the lock makes
+    the read-check-write atomic so exactly one mint wins and every loser
+    gets StaleEpochError (the loser then re-reads and finds the work done —
+    the MembershipService resolve_loss contract)."""
+    import threading
+
+    store = DictStore()
+    store.write(EPOCH_KEY, {"epoch": 1, "members": [0, 1, 2, 3]})
+    outcomes = []
+    barrier = threading.Barrier(8)
+
+    def _mint(i):
+        barrier.wait()
+        try:
+            store.mint_epoch({"epoch": 2, "members": [0, 1], "minter": i}, expected=1)
+            outcomes.append(("won", i))
+        except StaleEpochError:
+            outcomes.append(("lost", i))
+
+    threads = [threading.Thread(target=_mint, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [o for o in outcomes if o[0] == "won"]
+    assert len(wins) == 1, outcomes
+    assert store.read(EPOCH_KEY)["minter"] == wins[0][1]
