@@ -258,6 +258,31 @@ def _self_check(compile: bool):
                 f"kernel: {kernel_engine._kernel_fallback_reason}"
             )
 
+    # -- the speculative engine: the one NEW device program speculation adds
+    # is the windowed verify step (the draft's own decode/prefill programs
+    # are shape-twins of the serving programs already gated above, on the
+    # draft model's jit cache). `serving_speculative_verify` pins it:
+    # donation must survive the window widening, and page tables + per-slot
+    # emit limits must ride as ARGUMENTS — a baked table would recompile per
+    # step, a baked limit would freeze the emit cap into the executable
+    from ..serving import SpeculativeConfig
+
+    draft = Llama(
+        llama.config.replace(
+            hidden_size=64, intermediate_size=176, num_layers=1,
+            num_heads=2, num_kv_heads=2,
+        )
+    )
+    spec_engine = ServingEngine(
+        llama,
+        lparams,
+        speculative=SpeculativeConfig(
+            draft_model=draft, draft_params=draft.init(jax.random.key(1)), k=4
+        ),
+        **engine_kwargs,
+    )
+    reports.append(spec_engine.analyze(compile=compile, write_record=False))
+
     # the routed decode path: replication must not change the program, so a
     # 2-replica fleet's per-replica audits must come back exactly as clean
     # (donation intact on EVERY replica) as the lone engine's above — the

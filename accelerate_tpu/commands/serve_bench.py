@@ -142,6 +142,31 @@ def register_subcommand(subparsers):
         "default covers a whole bench run, so the end-of-run burn-rate "
         "line reflects every trace; narrow it to drill alert-style windows",
     )
+    parser.add_argument(
+        "--speculative", action="store_true",
+        help="Draft-model speculative decoding (docs/serving.md): the draft "
+        "proposes --spec-k tokens per step against its own paged pool and "
+        "the target verifies the whole window in one decode step. "
+        "Temperature-0 + paged only; tokens stay bit-identical",
+    )
+    parser.add_argument(
+        "--draft-model", default=None,
+        help="Registry name of the draft model (must share the target's "
+        "vocabulary). Default: the target's own architecture at half depth",
+    )
+    parser.add_argument(
+        "--spec-k", type=int, default=4,
+        help="Draft tokens proposed per speculative step",
+    )
+    parser.add_argument(
+        "--spec-mode", choices=("linear", "tree"), default="linear",
+        help="linear: one draft chain; tree: fork --spec-branches candidate "
+        "chains over COW-shared prefix pages and keep the best",
+    )
+    parser.add_argument(
+        "--spec-branches", type=int, default=2,
+        help="Tree-mode branch count (top-B seeds from the draft)",
+    )
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--int8", action="store_true", help="int8 weight-only load path")
@@ -192,6 +217,43 @@ def run(args) -> int:
     if jax.default_backend() != "cpu":
         params = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+        )
+
+    spec_cfg = None
+    if args.speculative:
+        if args.no_paged:
+            print("--speculative verifies against the paged pool — drop --no-paged")
+            return 1
+        if args.temperature != 0.0:
+            print("--speculative is temperature-0 only (greedy verify)")
+            return 1
+        from ..serving import SpeculativeConfig
+
+        if args.draft_model:
+            draft = build_model(args.draft_model)
+            if draft.config.vocab_size != model.config.vocab_size:
+                print(
+                    f"--draft-model {args.draft_model} has vocab "
+                    f"{draft.config.vocab_size}, target has "
+                    f"{model.config.vocab_size} — drafts must share the "
+                    "target's vocabulary"
+                )
+                return 1
+        else:
+            # default draft: the target's own architecture at half depth —
+            # vocabulary and head geometry stay valid by construction
+            draft = type(model)(
+                model.config.replace(num_layers=max(1, model.config.num_layers // 2))
+            )
+        draft_params = draft.init(jax.random.key(args.seed + 1))
+        if jax.default_backend() != "cpu":
+            draft_params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+                draft_params,
+            )
+        spec_cfg = SpeculativeConfig(
+            draft_model=draft, draft_params=draft_params, k=args.spec_k,
+            mode=args.spec_mode, num_branches=args.spec_branches,
         )
     use_kernels = not args.no_kernels
     if args.int8:
@@ -264,7 +326,7 @@ def run(args) -> int:
             eos_token_id=args.eos_token_id, temperature=args.temperature,
             paged=not args.no_paged, page_size=args.page_size,
             prefill_chunk=args.prefill_chunk, tracer=tracer,
-            use_kernels=use_kernels,
+            use_kernels=use_kernels, speculative=spec_cfg,
         )
         # the hub attaches AFTER construction (exactly like the router wires
         # replicas): a hub passed to the constructor would also hand the
@@ -394,6 +456,15 @@ def run(args) -> int:
         "paged": not args.no_paged,
         "page_size": args.page_size if not args.no_paged else None,
         "prefill_chunk": args.prefill_chunk,
+        "speculative": (
+            {
+                "k": args.spec_k,
+                "mode": args.spec_mode,
+                "draft_model": args.draft_model or "auto-half-depth",
+            }
+            if spec_cfg is not None
+            else None
+        ),
         "mixed": bool(args.mixed),
         "shared_prefix": args.shared_prefix,
         # each sweep point's engine carries its own CompileTracker, scoped to
@@ -459,6 +530,19 @@ def run(args) -> int:
         + (" — per pool" if disagg else (" — per replica" if n_replicas > 1 else ""))
         + ")"
     )
+    if spec_cfg is not None:
+        sat = points[-1]
+        proposed = sat.get("spec_proposed_tokens", 0)
+        accepted = sat.get("spec_accepted_tokens", 0)
+        acc_rate = accepted / proposed if proposed else 0.0
+        print(
+            f"speculative: mode={args.spec_mode} k={args.spec_k} "
+            f"draft={payload['speculative']['draft_model']} — "
+            f"{accepted}/{proposed} draft tokens accepted ({acc_rate:.0%}), "
+            f"accepted-len p50 {sat.get('spec_accepted_len_p50', 0.0)} / "
+            f"p99 {sat.get('spec_accepted_len_p99', 0.0)}, "
+            f"{sat.get('spec_fallbacks', 0)} fallbacks"
+        )
     header = (
         f"{'offered req/s':>14} | {'tok/s':>9} | {'ttft p50':>9} | {'ttft p99':>9} | "
         f"{'tok p50':>8} | {'tok p99':>8} | {'occupancy':>9}"

@@ -75,6 +75,60 @@ def forward_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: 
     return logits.astype(jnp.float32), new_cache
 
 
+def forward_window_with_cache(model: Llama, params: dict, input_ids: jax.Array, cache: dict):
+    """Speculative-verify window forward: like :func:`forward_with_cache`
+    but returns logits for EVERY position ``[B, S, V]`` — the target model
+    scores a whole k+1-token candidate window in one step and the engine
+    needs the greedy token after each window position to find the longest
+    agreeing prefix.
+
+    Paged-attend protocol only: the causal mask inside the window lives in
+    the ``attend`` hook (kernel or gathered reference), not here, so a cache
+    without one cannot be scored correctly."""
+    if "attend" not in cache:
+        raise ValueError(
+            "forward_window_with_cache requires the paged 'attend' protocol "
+            "(the in-window causal mask lives in the attend hook)"
+        )
+    cfg = model.config
+    b, s = input_ids.shape
+    length = cache["length"]
+    h = jnp.take(params["embed_tokens"], input_ids, axis=0)
+    positions = length + jnp.arange(s)[None, :]
+    cos, sin = rotary_embedding(positions, cfg.dim_per_head, cfg.rope_theta, dtype=h.dtype)
+    extra = {key: cache[key] for key in ("table", "attend") if key in cache}
+
+    def body(carry, xs):
+        h = carry
+        lp, k_cache, v_cache = xs
+        h, new_cache = decoder_layer(
+            cfg, h, lp, cos, sin, None,
+            cache={"k": k_cache, "v": v_cache, "length": length, **extra},
+            dot_fn=getattr(model, "dot_fn", None),
+        )
+        return h, (new_cache["k"], new_cache["v"])
+
+    h, (k_cache, v_cache) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = h @ head.astype(h.dtype)  # all positions, not just the last
+    new_cache = {"k": k_cache, "v": v_cache, "length": length + s}
+    return logits.astype(jnp.float32), new_cache
+
+
+def resolve_window_protocol(model):
+    """The window-forward half of the decode protocol: ``forward_window(
+    params, ids, cache) -> (all-position logits [B, S, V], cache)``.
+
+    Mirrors :func:`resolve_decode_protocol`: models that implement
+    ``forward_window_with_cache`` themselves (GPT2) contribute their method;
+    the llama family's (incl. GQA) lives in this module. The serving
+    engine's speculative verify drives models exclusively through this."""
+    if hasattr(model, "forward_window_with_cache"):
+        return model.forward_window_with_cache
+    return lambda p, ids, c: forward_window_with_cache(model, p, ids, c)
+
+
 def _jit_for(model, name, build):
     """Per-model jit cache so repeated generate() calls reuse compilations;
     dot_fn-invalidated (see utils/jit_cache.py)."""

@@ -185,6 +185,35 @@ class GPT2:
         logits = self.decode_suffix(params, carry)
         return logits, {"k": k_cache, "v": v_cache, "length": length + s}
 
+    def forward_window_with_cache(self, params: dict, input_ids: jax.Array, cache: dict):
+        """Speculative-verify window forward: all-position logits [B, S, V]
+        (models/generation.py resolve_window_protocol). Paged-attend only —
+        the in-window causal mask lives in the attend hook, and the learned
+        positions beyond max_seq_len that jnp.take would clamp are never
+        emitted (the engine's per-slot window limit caps at capacity)."""
+        if "attend" not in cache:
+            raise ValueError(
+                "forward_window_with_cache requires the paged 'attend' protocol "
+                "(the in-window causal mask lives in the attend hook)"
+            )
+        b, s = input_ids.shape
+        length = cache["length"]
+        extra = {key: cache[key] for key in ("table", "attend") if key in cache}
+        carry = self.decode_prefix(params, input_ids, length, max_len=self.config.max_seq_len)
+
+        def body(carry, xs):
+            lp, k_cache, v_cache = xs
+            carry, nc = self.stream_layer_cached(
+                carry, lp, {"k": k_cache, "v": v_cache, **extra}, length
+            )
+            return carry, (nc["k"], nc["v"])
+
+        carry, (k_cache, v_cache) = jax.lax.scan(body, carry, (params["layers"], cache["k"], cache["v"]))
+        h, _ = carry
+        h = layer_norm(h, params["final_norm_scale"], params["final_norm_bias"], self.config.norm_eps)
+        logits = (h @ params["embed_tokens"].T.astype(h.dtype)).astype(jnp.float32)
+        return logits, {"k": k_cache, "v": v_cache, "length": length + s}
+
     # -- forward -----------------------------------------------------------
 
     def apply(

@@ -214,3 +214,164 @@ def paged_decode_attention(
         pool_v,
     )
     return out.reshape(1, 1, nh, d)
+
+
+def _verify_kernel(
+    table_ref,  # SMEM [1, pps] int32: this slot's page-table row
+    length_ref,  # SMEM [1, 1] int32: committed positions in the pool
+    q_ref,  # VMEM [1, W*group, D]: window queries, row = wi*group + gi (pre-scaled)
+    kn_ref,  # VMEM [1, W, D]: the window's keys for this kv head (pre-scatter)
+    vn_ref,  # VMEM [1, W, D]
+    pool_k_ref,  # ANY (HBM) [P, ps, KV, D]
+    pool_v_ref,  # ANY (HBM) [P, ps, KV, D]
+    o_ref,  # VMEM [1, W*group, D] out
+    k_scratch,  # VMEM [ps, D] pool dtype
+    v_scratch,  # VMEM [ps, D]
+    sems,  # DMA semaphores (2,)
+    *,
+    page_size: int,
+    window: int,
+    group: int,
+):
+    g = pl.program_id(0)  # kv head (slot axis joins via vmap batching)
+    length = length_ref[0, 0]
+    q = q_ref[0]  # [W*group, D]
+    rows, d = q.shape
+
+    m = jnp.full((rows, 1), M_INIT, jnp.float32)
+    l = jnp.zeros((rows, 1), jnp.float32)
+    acc = jnp.zeros((rows, d), jnp.float32)
+
+    # committed pages (positions 0..length-1): every window row attends all
+    # of them — the page walk is the decode kernel's, with W*group query rows
+    npages = jax.lax.div(length + jnp.int32(page_size - 1), jnp.int32(page_size))
+    pos_in_page = jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 1)
+
+    def body(j, carry):
+        m, l, acc = carry
+        page = table_ref[0, j]
+        k_dma = pltpu.make_async_copy(pool_k_ref.at[page, :, g, :], k_scratch, sems.at[0])
+        v_dma = pltpu.make_async_copy(pool_v_ref.at[page, :, g, :], v_scratch, sems.at[1])
+        k_dma.start()
+        v_dma.start()
+        k_dma.wait()
+        v_dma.wait()
+        s = jax.lax.dot_general(
+            q, k_scratch[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, ps]
+        s = jnp.where(j * page_size + pos_in_page < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p.astype(v_scratch.dtype), v_scratch[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, npages, body, (m, l, acc))
+
+    # the candidate window (positions length..length+W-1) is not in the pool
+    # yet — the engine's write-back is a separate masked scatter — so it folds
+    # in as one final block with a causal mask INSIDE the window: query row
+    # wi*group+gi (window position wi) may attend window keys 0..wi. Row 0
+    # attends exactly its own key, reducing to the decode kernel at W=1.
+    kn = kn_ref[0]  # [W, D]
+    vn = vn_ref[0]
+    s_w = jax.lax.dot_general(
+        q, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [rows, W]
+    row_pos = jax.lax.broadcasted_iota(jnp.int32, (rows, window), 0) // group
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (rows, window), 1)
+    s_w = jnp.where(key_pos <= row_pos, s_w, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s_w, axis=-1, keepdims=True))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s_w - m_new)
+    l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * correction + jax.lax.dot_general(
+        p.astype(vn.dtype), vn, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _verify_reference(q, k_new, v_new, pool_k, pool_v, table, length, scale):
+    """Gather-based fallback with the verify kernel's exact masking
+    semantics: the table-gathered view (positions < length valid) plus the
+    candidate window under a lower-triangular in-window mask."""
+    from ..models.attention import dot_product_attention
+
+    taken_k = jnp.take(pool_k, table, axis=0).reshape(-1, *pool_k.shape[2:])
+    taken_v = jnp.take(pool_v, table, axis=0).reshape(-1, *pool_v.shape[2:])
+    keys = jnp.concatenate([taken_k, k_new[0]], axis=0)[None]  # [1, T+W, KV, D]
+    values = jnp.concatenate([taken_v, v_new[0]], axis=0)[None]
+    t = taken_k.shape[0]
+    w = q.shape[1]
+    committed = jnp.broadcast_to(jnp.arange(t)[None, :] < length, (w, t))
+    in_window = jnp.tril(jnp.ones((w, w), bool))
+    valid = jnp.concatenate([committed, in_window], axis=1)[None, None]  # [1,1,W,T+W]
+    return dot_product_attention(q, keys, values, mask=valid, scale=scale)
+
+
+def paged_verify_attention(
+    q: jax.Array,  # [1, W, NH, D]: one slot's candidate-window queries
+    k_new: jax.Array,  # [1, W, KV, D]: the window's keys (pre-scatter)
+    v_new: jax.Array,  # [1, W, KV, D]
+    pool_k: jax.Array,  # [P, page_size, KV, D]: one layer of the page pool
+    pool_v: jax.Array,  # [P, page_size, KV, D]
+    table: jax.Array,  # [pps] int32 page-table row
+    length: jax.Array,  # scalar int32: committed positions in the pool
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Speculative-decoding verify: score a W=k+1 candidate window against a
+    slot's paged KV in ONE launch — the decode kernel with a window axis.
+    Each (slot, kv-head) program walks the committed pages exactly as
+    :func:`paged_decode_attention` does, then folds the window's own keys in
+    under a causal in-window mask. The serving engine threads this as the
+    ``attend`` hook of the window protocol
+    (:func:`~..models.generation.forward_window_with_cache`); its vmap over
+    slots batches the launch."""
+    _, w, nh, d = q.shape
+    kv = k_new.shape[2]
+    ps = pool_k.shape[-3]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    if paged_kernel_fallback_reason(pool_k.shape, nh, kv) is not None:
+        return _verify_reference(q, k_new, v_new, pool_k, pool_v, table, length, scale)
+    qs = (q * jnp.asarray(scale, q.dtype))[0]  # [W, NH, D]
+    group = nh // kv
+    # row layout (kv, W*group): row wi*group+gi is window position wi of the
+    # gi-th query head sharing kv head g — head h = g*group+gi, as in decode
+    qs = qs.reshape(w, kv, group, d).transpose(1, 0, 2, 3).reshape(kv, w * group, d)
+    out = pl.pallas_call(
+        functools.partial(_verify_kernel, page_size=ps, window=w, group=group),
+        grid=(kv,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # table [1, pps]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # length [1, 1]
+            pl.BlockSpec((1, w * group, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, w * group, d), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kv, w * group, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((ps, d), pool_k.dtype),
+            pltpu.VMEM((ps, d), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret_mode(),
+    )(
+        table.reshape(1, -1).astype(jnp.int32),
+        jnp.asarray(length, jnp.int32).reshape(1, 1),
+        qs,
+        jnp.moveaxis(k_new[0], 1, 0),  # (KV, W, D)
+        jnp.moveaxis(v_new[0], 1, 0),
+        pool_k,
+        pool_v,
+    )
+    return out.reshape(kv, w, group, d).transpose(1, 0, 2, 3).reshape(1, w, nh, d)

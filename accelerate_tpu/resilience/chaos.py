@@ -62,7 +62,13 @@ Fault legs:
   (0-based, process-wide transfer sequence — elastic relays, regrows, and
   KV-handoff page transfers all count) mid-transfer. The primitive's ladder
   must degrade staged → host relay with the source intact, or fail loud
-  NAMING the stage when the fallback is pinned off.
+  NAMING the stage when the fallback is pinned off;
+- ``spec_disable_step`` — the speculative-decoding drill
+  (serving/speculative.py): at the chosen serving step the engine's draft
+  model is declared gone and speculation disables PERMANENTLY mid-stream —
+  the engine must fall back to plain paged decode without dropping or
+  duplicating a single token (both paths consume the same pending token at
+  the same position, so the drill asserts bit-equal output).
 
 Activation: pass a plan to ``ResilienceConfig(fault_plan=...)`` /
 ``ServingEngine(fault_plan=...)``, or export ``ACCELERATE_CHAOS_*`` (see
@@ -139,6 +145,11 @@ class FaultPlan:
     # index selects WHICH stage of the decomposition dies mid-transfer
     redistribute_fail_at: tuple[int, ...] = ()
     redistribute_fail_stage: int = 0
+    # speculative-decoding fault: the serving step (0-based, engine._steps
+    # BEFORE the step) at which speculation is disabled MID-STREAM — the
+    # drill asserts the engine falls back to plain decode without dropping
+    # or duplicating a token (serving/speculative.py)
+    spec_disable_step: Optional[int] = None
 
     # ledger of injected faults (appended in firing order); ``sink`` is set by
     # the resilience hub so every injection also lands in telemetry.jsonl
@@ -169,6 +180,7 @@ class FaultPlan:
         hl_step = env.get("ACCELERATE_CHAOS_HOST_LOSS_STEP")
         ms_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_STEP")
         mst_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_STALL_STEP")
+        spec_step = env.get("ACCELERATE_CHAOS_SPEC_DISABLE_STEP")
         return cls(
             seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
             nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
@@ -203,6 +215,7 @@ class FaultPlan:
             redistribute_fail_stage=int(
                 env.get("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_STAGE", "0")
             ),
+            spec_disable_step=int(spec_step) if spec_step else None,
         )
 
     @property
@@ -222,6 +235,7 @@ class FaultPlan:
             or self.handoff_stall_at
             or self.handoff_loss_at
             or self.redistribute_fail_at
+            or self.spec_disable_step is not None
         )
 
     def _record(self, fault: str, **detail) -> None:
@@ -272,6 +286,14 @@ class FaultPlan:
             self._record("serving_burst", step=engine_step, size=self.serving_burst_size)
             return self.serving_burst_size
         return 0
+
+    def spec_disable(self, engine_step: int) -> bool:
+        """Whether to disable speculative decoding before this engine step
+        (permanent: the engine's fallback to plain decode is one-way)."""
+        if self.spec_disable_step == engine_step:
+            self._record("spec_disable", step=engine_step)
+            return True
+        return False
 
     # -- fleet-side hooks (driven by ServingRouter per fleet step) -----------
 

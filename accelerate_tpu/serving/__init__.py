@@ -12,8 +12,12 @@ fleet-wide. With per-replica ``roles=`` the fleet disaggregates into
 prefill and decode pools: prompts prefill on one pool, the live KV hands
 off page-by-page to the other (transactional, chaos-drilled, falling back
 to re-prefill), and TTFT stops competing with decode steps for the same
-chips. Later serving work (speculative decoding, multi-host serve meshes)
-builds on these pieces.
+chips. ``speculative.py`` adds draft-model speculative decoding on top of
+the paged engine: a small draft proposes k tokens against its own paged KV
+pool (sharing the engine's page tables), the target verifies the whole
+window in ONE decode step, and tree mode forks shared prefix pages by
+refcount to race several candidate branches. Later serving work
+(multi-host serve meshes) builds on these pieces.
 """
 
 from .engine import (
@@ -43,6 +47,7 @@ from .loadgen import make_mixed_prompts, make_prompts, run_offered_load
 from .paging import PageAllocator, PagedKVCache, PrefixCache, pages_for
 from .router import RoutedRequest, ServingRouter
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
+from .speculative import SpeculativeConfig
 
 __all__ = [
     "ContinuousBatchingScheduler",
@@ -63,6 +68,7 @@ __all__ = [
     "ServingRouter",
     "SlotAllocator",
     "SlotKVCache",
+    "SpeculativeConfig",
     "StepWatchdog",
     "bucket_for",
     "kv_cache_bytes",

@@ -272,6 +272,7 @@ class ServingEngine:
         prefix_sharing: bool = True,
         prefix_cache_entries: int = 256,
         use_kernels: Optional[bool] = None,
+        speculative: Optional[Any] = None,
     ):
         self.model = model
         # ``name`` tags this engine's telemetry records — a routed fleet sets
@@ -341,6 +342,40 @@ class ServingEngine:
         self._prefill_caches: dict[int, dict] = {}  # zero cache template per bucket
         # cache donation halves decode HBM traffic; unsupported on CPU (warns)
         self._donate = jax.default_backend() in ("tpu", "gpu")
+        # -- speculative decoding (serving/speculative.py) ------------------
+        # the draft model's pools/programs/tracking live in SpeculativeState;
+        # the verify program and window bookkeeping live here. Temperature-0
+        # only: acceptance is exact greedy-token match, which is what makes
+        # speculative output token-bit-equal plain decode (sampled
+        # temperatures need rejection sampling — ROADMAP).
+        self.spec = None
+        self._fwd_window = None
+        if speculative is not None:
+            from ..models.generation import resolve_window_protocol
+            from .speculative import SpeculativeState
+
+            if not paged:
+                raise ValueError(
+                    "speculative decoding needs the paged engine (paged=True): "
+                    "the draft pool shares the page tables"
+                )
+            if self.temperature != 0.0:
+                raise ValueError(
+                    "speculative decoding is temperature-0 only (acceptance is "
+                    "exact greedy match; sampled temperatures need rejection "
+                    f"sampling — see ROADMAP), got temperature={self.temperature}"
+                )
+            tgt_vocab = getattr(getattr(model, "config", None), "vocab_size", None)
+            drf_vocab = getattr(
+                getattr(speculative.draft_model, "config", None), "vocab_size", None
+            )
+            if tgt_vocab is not None and drf_vocab is not None and tgt_vocab != drf_vocab:
+                raise ValueError(
+                    f"draft vocab_size {drf_vocab} != target vocab_size "
+                    f"{tgt_vocab}: drafted token ids would not be target tokens"
+                )
+            self.spec = SpeculativeState(speculative, self.cache, donate=self._donate)
+            self._fwd_window = resolve_window_protocol(model)
         self.telemetry = telemetry
         self.stats = ServingStats(
             num_slots,
@@ -571,6 +606,113 @@ class ServingEngine:
             build,
         )
 
+    def _spec_verify_program(self):
+        """Speculative verify: score one ``k+1``-token candidate window per
+        slot — the pending input token plus the draft's ``k`` candidates —
+        in ONE target-model step, and commit the longest agreeing prefix on
+        device. Window shapes are fixed at construction (``w = k + 1``), so
+        this is one program for the engine's lifetime.
+
+        Acceptance is pure greedy agreement: with ``toks[j] = argmax`` of
+        the logits after window position ``j``, candidate ``c_{j+1}`` (=
+        ``window[j+1]``) is accepted iff it equals ``toks[j]`` and every
+        earlier candidate was accepted — ``accepted = Σ cumprod(eq)``. The
+        emitted run is ``toks[0..emit-1]`` with ``emit = min(accepted + 1,
+        limits)``: every emitted token is the target's OWN argmax
+        conditioned on inputs the acceptance rule just proved correct, which
+        is the temperature-0 bit-equality guarantee — and why a slot with no
+        (valid) draft still emits exactly its plain-decode token under
+        ``limits = 1``. The write-back is the decode scatter widened to a
+        masked WINDOW scatter: positions ``length .. length+emit-1`` land in
+        the slot's pages, rejected/unused window rows redirect to the null
+        page with zeroed values.
+
+        The attend hook is the same duality as decode: the Pallas verify
+        kernel (``paged_verify_attention``) or the ``_gathered_view``
+        reference — committed pages gathered through the table row, window
+        keys concatenated behind them, causal-inside-the-window mask."""
+        fwd_window = self._fwd_window
+        ps = self.cache.page_size
+        pps = self.cache.pages_per_slot
+        w = self.spec.config.k + 1
+        gathered = self._gathered_view
+        use_kernel = self._use_decode_kernel
+
+        def build():
+            if use_kernel:
+                from ..ops.paged_attention import paged_verify_attention
+
+                def attend(q, kn, vn, c):
+                    return paged_verify_attention(
+                        q, kn, vn, c["k"], c["v"], c["table"], c["length"]
+                    )
+            else:
+                from ..models.attention import dot_product_attention
+
+                def attend(q, kn, vn, c):
+                    # the reference verify path: gather the slot's committed
+                    # pages exactly as decode does, then attend over
+                    # [committed view | window] with the in-window causal
+                    # mask — row j sees positions < length plus window rows
+                    # <= j. (The model's DUS write path cannot serve here:
+                    # near view_len the clamp would misplace window K/V.)
+                    view = gathered(c["k"][None], c["v"][None], c["table"], c["length"])
+                    keys = jnp.concatenate([view["k"][0].astype(q.dtype), kn], axis=1)
+                    values = jnp.concatenate([view["v"][0].astype(q.dtype), vn], axis=1)
+                    t = view["k"].shape[2]
+                    committed = jnp.broadcast_to(
+                        jnp.arange(t)[None, :] < c["length"], (w, t)
+                    )
+                    in_window = jnp.tril(jnp.ones((w, w), bool))
+                    mask = jnp.concatenate([committed, in_window], axis=1)[None, None]
+                    return dot_product_attention(q, keys, values, mask=mask)
+
+            def verify_step(params, pk, pv, window, lengths, active, limits, tables):
+                def one_slot(win, row, length):
+                    cache = {"k": pk, "v": pv, "length": length,
+                             "table": row, "attend": attend}
+                    logits, nc = fwd_window(params, win[None, :], cache)
+                    ok = jnp.all(jnp.isfinite(logits))
+                    return logits[0], ok, nc["k"][:, 0], nc["v"][:, 0]
+
+                logits, ok, wk, wv = jax.vmap(one_slot)(window, tables, lengths)
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, w]
+                eq = (window[:, 1:] == toks[:, :-1]).astype(jnp.int32)
+                accepted = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)
+                emit = jnp.where(active, jnp.minimum(accepted + 1, limits), 0)
+                # masked window scatter: the decode write-back widened to w
+                # rows. Unemitted rows (and inactive lanes) redirect to the
+                # null page with ZEROED values so it stays finite; emitted
+                # rows land at length..length+emit-1 through the table row
+                # (pre-grown by the host, so page_idx < pps for every
+                # emitted row — the clip only disciplines masked lanes).
+                pos = lengths[:, None] + jnp.arange(w)[None, :]  # [S, w]
+                write = active[:, None] & (jnp.arange(w)[None, :] < emit[:, None])
+                page_idx = jnp.minimum(pos // ps, pps - 1)
+                wpage = jnp.where(write, jnp.take_along_axis(tables, page_idx, axis=1), 0)
+                woff = jnp.where(write, pos % ps, 0)
+                lane = write[:, None, :, None, None]
+                wk = jnp.where(lane, wk.astype(pk.dtype), jnp.zeros((), pk.dtype))
+                wv = jnp.where(lane, wv.astype(pv.dtype), jnp.zeros((), pv.dtype))
+                flat_k = jnp.moveaxis(wk, 1, 0).reshape(
+                    (wk.shape[1], wk.shape[0] * w) + wk.shape[3:]
+                )
+                flat_v = jnp.moveaxis(wv, 1, 0).reshape(
+                    (wv.shape[1], wv.shape[0] * w) + wv.shape[3:]
+                )
+                pk = pk.at[:, wpage.reshape(-1), woff.reshape(-1)].set(flat_k)
+                pv = pv.at[:, wpage.reshape(-1), woff.reshape(-1)].set(flat_v)
+                return toks, accepted, emit, ok, pk, pv
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(verify_step, donate_argnums=donate)
+
+        return self._jit(
+            ("serve_spec_verify", self.cache.num_slots, self.cache.view_len, ps,
+             w, self._donate, use_kernel),
+            build,
+        )
+
     def _paged_prefill_program(self, span: int):
         """Prefill ``span`` tokens (one chunk, or a whole bucketed suffix)
         starting at the PAGE-ALIGNED position ``start``, scattering the
@@ -735,6 +877,10 @@ class ServingEngine:
                         self.params, ids, self.cache.k, self.cache.v, row,
                         np.int32(0),
                     )
+                    if self.spec is not None:
+                        # every span program has a draft-pool mirror that
+                        # traffic (or catch-up) can select
+                        self.spec.prefill(span, ids, row, 0)
                 # the handoff pair (extract + adopt-insert) fires in steady
                 # state whenever this engine is a disaggregated pool member:
                 # compile both now against the null page (reading it is free,
@@ -743,6 +889,35 @@ class ServingEngine:
                 self.cache.k, self.cache.v = self._page_insert_program()(
                     self.cache.k, self.cache.v, kb[0], vb[0], np.int32(0)
                 )
+                if self.spec is not None:
+                    # the synthetic requests above never draft (1-token
+                    # budgets), so the draft decode launch — and tree mode's
+                    # top-B seed variant — must compile explicitly, against
+                    # all-inactive lanes (writes land in the null page).
+                    # The plain paged decode compiles the same way: it is
+                    # the chaos/disable fallback and must engage mid-stream
+                    # without a compile stall.
+                    zeros = np.zeros((self.cache.num_slots,), np.int32)
+                    inactive = np.zeros((self.cache.num_slots,), bool)
+                    self.spec.decode(zeros, zeros, inactive, self.cache.tables)
+                    if self.spec.config.mode == "tree":
+                        self.spec.decode(
+                            zeros, zeros, inactive, self.cache.tables,
+                            top_b=self.spec.config.num_branches,
+                        )
+                        # branch forking COW-copies the boundary page in BOTH
+                        # pools on every tree step — compile both copy
+                        # programs now (null page onto itself: an identity
+                        # write, free to run)
+                        self.cache.k, self.cache.v = self._page_copy_program()(
+                            self.cache.k, self.cache.v, np.int32(0), np.int32(0)
+                        )
+                        self.spec.copy_page(0, 0)
+                    keys = jax.random.split(self._rng, self.cache.num_slots)
+                    _, _, self.cache.k, self.cache.v = self._paged_decode_program()(
+                        self.params, self.cache.k, self.cache.v, zeros,
+                        zeros, inactive, self.cache.tables, keys,
+                    )
         finally:
             self._warming = False
 
@@ -1039,6 +1214,13 @@ class ServingEngine:
             # prefill runs in _advance_prefills (chunked: one span per step;
             # monolithic: the whole suffix this same step) — admission only
             # claimed capacity
+            if self.spec is not None:
+                # fresh seat: draft health is per-REQUEST, and a prefix hit's
+                # shared pages already carry the original request's mirrored
+                # draft content (speculative.py), so drafting resumes from
+                # the hit rather than position 0
+                self.spec.draft_ok[slot] = True
+                self.spec.draft_len[slot] = request.prefilled
             return
         prefill_len = request.prompt.size - 1
         if prefill_len > 0:
@@ -1133,6 +1315,16 @@ class ServingEngine:
                 self.params, ids, self.cache.k, self.cache.v,
                 self.cache.tables[slot].copy(), np.int32(request.prefilled),
             )
+            if self.spec is not None and self.spec.enabled:
+                # mirror the span into the draft pool (same ids, same row,
+                # same start) so the slot can draft the moment it decodes —
+                # and so pages this prefill registers in the prefix cache
+                # carry draft content for future sharers
+                self.spec.prefill(
+                    span, ids, self.cache.tables[slot].copy(), request.prefilled
+                )
+                if int(self.spec.draft_len[slot]) == request.prefilled:
+                    self.spec.draft_len[slot] = request.prefilled + take
             request.prefilled += take
             self.stats.record_prefill(span)
             if chunked_span:
@@ -1287,7 +1479,390 @@ class ServingEngine:
                     self.cache.k, self.cache.v, np.int32(src), np.int32(dst)
                 )
                 self.stats.record_cow_copy()
+                if self.spec is not None and self.spec.enabled:
+                    # the draft pool indexes through the SAME table row: the
+                    # privatized page must carry its draft content forward
+                    # too, or the draft would predict from a blank prefix
+                    self.spec.copy_page(src, dst)
         return failed
+
+    # -- speculative decoding (serving/speculative.py; docs/serving.md) -----
+
+    def disable_speculation(self, reason: str) -> None:
+        """Permanent opt-out (chaos drill / operator override): the engine
+        falls back to the plain paged decode program from the NEXT device
+        step. The fallback is seamless by construction — both paths consume
+        ``_pending[slot]`` at position ``lengths[slot]`` and advance by
+        exactly what they emit, so no token is dropped or duplicated across
+        the switch."""
+        if self.spec is None or not self.spec.enabled:
+            return
+        self.spec.disable(reason)
+        self.stats.record_spec_fallback()
+        self._resilience({"event": "spec_disabled", "reason": reason})
+        if self.telemetry is not None:
+            payload = {
+                "event": "disabled", "fallback_reason": reason,
+                "k": self.spec.config.k, "mode": self.spec.config.mode,
+            }
+            if self.name is not None:
+                payload = {"engine": self.name, **payload}
+            self.telemetry.write_record("speculative", payload)
+
+    def _spec_catch_up(self, slot: int, request) -> None:
+        """Bring the draft pool's content for ``slot`` up to the committed
+        length via mirrored prefill spans (adopted/resumed slots, or a
+        stretch the slot spent not drafting). The token history is exact by
+        the engine's own invariant — input at position ``p`` is
+        ``concat(prompt, generated)[p]`` for every ``p < length`` — and
+        spans re-use the compiled draft prefill mirrors, page-aligned at
+        ``draft_len``'s page. Padded span tails land in the draft pool's
+        null page: finite garbage in the designated sink, exactly like
+        warmup's direct span compiles."""
+        spec = self.spec
+        ps = self.cache.page_size
+        length = int(self.cache.lengths[slot])
+        history = None
+        while int(spec.draft_len[slot]) < length:
+            start = (int(spec.draft_len[slot]) // ps) * ps
+            span = self._next_span(length - start, start)
+            take = min(span, length - start)
+            if history is None:
+                history = np.concatenate(
+                    [request.prompt, np.asarray(request.generated, np.int32)]
+                )
+            ids = np.zeros((1, span), np.int32)
+            ids[0, :take] = history[start : start + take]
+            spec.prefill(span, ids, self.cache.tables[slot].copy(), start)
+            spec.draft_len[slot] = start + take
+
+    def _spec_limits(self, active_idx) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side per-slot emit caps for one speculative step. Active
+        lanes get at least 1 (the verify of a bare pending token IS the
+        plain decode); slots eligible to draft — healthy, draft pool caught
+        up, more than one token of budget left, window pages securable —
+        get ``min(k, budget)``. The cap stays at ``k`` (not ``k + 1``):
+        dropping the bonus token keeps ``draft_len == lengths`` in steady
+        state, so eligibility never flaps."""
+        spec = self.spec
+        k = spec.config.k
+        ps = self.cache.page_size
+        limits = np.ones((self.cache.num_slots,), np.int32)
+        drafting = np.zeros((self.cache.num_slots,), bool)
+        for slot in active_idx:
+            request = self.scheduler.slots[slot]
+            if request is None or not self.cache.active[slot]:
+                continue
+            budget = request.max_new_tokens - len(request.generated)
+            if budget <= 1 or not spec.draft_ok[slot]:
+                continue
+            length = int(self.cache.lengths[slot])
+            if int(spec.draft_len[slot]) < length:
+                self._spec_catch_up(slot, request)
+            if int(spec.draft_len[slot]) != length:
+                continue
+            want = min(k, budget)
+            target = pages_for(length + want, ps)
+            need = target - int(self.cache.held[slot])
+            if need > 0 and not self.cache.grow(slot, need):
+                # page pressure: this step just doesn't speculate the slot
+                # (limits stays 1 — position `length` is already privately
+                # backed by _prepare_decode_writes, so plain-rate decode
+                # continues while the pool is tight)
+                self.stats.record_page_pressure()
+                continue
+            limits[slot] = want
+            drafting[slot] = True
+        return limits, drafting
+
+    def _spec_device_step(self, active_idx):
+        """One speculative decode step over every lane, REPLACING the plain
+        paged decode call: draft up to ``k`` candidates per eligible slot,
+        verify each slot's whole ``k+1`` window in ONE target-model step,
+        commit the longest agreeing prefix on device. Returns ``(tokens
+        [S, w], emit [S], finite [S], drafted [S])`` — ``finite`` is the
+        TARGET's verdict (the quarantine probe rides it exactly as on the
+        plain path; a non-finite DRAFT never reaches it), ``drafted`` marks
+        slots needing post-step trim + ``draft_len`` advance."""
+        spec = self.spec
+        k = spec.config.k
+        w = k + 1
+        limits, drafting = self._spec_limits(active_idx)
+        window = np.zeros((self.cache.num_slots, w), np.int32)
+        window[:, 0] = self._pending
+        sampled = (
+            self.tracer is not None
+            and not self._warming
+            and (self._steps + 1) % self.tracer.sample_every == 0
+        )
+        spanned = []
+        if sampled:
+            for slot in np.flatnonzero(drafting):
+                request = self.scheduler.slots[int(slot)]
+                if request is not None:
+                    spanned.append(int(slot))
+                    self.tracer.span_start(
+                        request.id, "draft", replica=self.name,
+                        k=k, mode=spec.config.mode,
+                    )
+        if spec.config.mode == "tree" and drafting.any():
+            out = self._spec_tree_step(window, limits, drafting, spanned)
+        else:
+            out = self._spec_linear_step(window, limits, drafting, spanned)
+        tokens_mat, emit, finite, drafted, accepted, proposed = out
+        if not self._warming and drafted.any():
+            acc = [max(int(emit[s]) - 1, 0) for s in np.flatnonzero(drafted)]
+            self.stats.record_spec_step(proposed=proposed, accepted_lengths=acc)
+            if self.telemetry is not None:
+                payload = {
+                    "step": self._steps, "k": k, "mode": spec.config.mode,
+                    "proposed_tokens": proposed, "accepted_lengths": acc,
+                    "fallback_reason": None,
+                }
+                if self.name is not None:
+                    payload = {"engine": self.name, **payload}
+                self.telemetry.write_record("speculative", payload)
+        return tokens_mat, emit, finite, drafted
+
+    def _spec_linear_step(self, window, limits, drafting, spanned):
+        """Linear mode: ONE greedy draft chain per drafting slot (launch
+        ``i`` consumes launch ``i-1``'s token at position ``length + i``),
+        then one full-batch verify. Each launch is masked to the slots
+        whose cap it still serves, so draft writes never pass
+        ``length + limits - 1`` — inside the pages ``_spec_limits`` just
+        secured."""
+        spec = self.spec
+        drafted = drafting.copy()
+        lengths0 = self.cache.lengths.copy()
+        chain = self._pending.copy()
+        proposed = 0
+        for i in range(int(limits.max()) if drafting.any() else 0):
+            step_active = drafting & (i < limits)
+            if not step_active.any():
+                break
+            nxt, dok = spec.decode(
+                np.where(step_active, chain, 0).astype(np.int32),
+                (lengths0 + i).astype(np.int32),
+                step_active,
+                self.cache.tables,
+            )
+            proposed += int(step_active.sum())
+            bad = step_active & ~dok
+            for slot in np.flatnonzero(bad):
+                # the DRAFT went non-finite for this slot: stop extending
+                # its chain and scrub its draft tail — verify is sovereign,
+                # so the candidates already in the window stay usable
+                spec.fail_slot(
+                    int(slot), self.cache.tables, int(self.cache.held[slot])
+                )
+                drafting[slot] = False
+            good = step_active & dok
+            window[good, i + 1] = nxt[good]
+            chain = np.where(good, nxt, chain).astype(np.int32)
+        if spanned:
+            for slot in spanned:
+                request = self.scheduler.slots[slot]
+                if request is not None:
+                    self.tracer.span_end(request.id, "draft", stats=self.stats)
+                    self.tracer.span_start(
+                        request.id, "verify", replica=self.name, window=len(window[slot]),
+                    )
+        toks, accepted, emit, vok, self.cache.k, self.cache.v = (
+            self._spec_verify_program()(
+                self.params, self.cache.k, self.cache.v, window,
+                self.cache.lengths, self.cache.active, limits,
+                self.cache.tables,
+            )
+        )
+        tokens_mat = np.asarray(toks)
+        emit_np = np.asarray(emit)
+        finite = np.asarray(vok)
+        accepted_np = np.asarray(accepted)
+        if spanned:
+            for slot in spanned:
+                request = self.scheduler.slots[slot]
+                if request is not None:
+                    self.tracer.span_end(
+                        request.id, "verify", stats=self.stats,
+                        accepted=int(accepted_np[slot]), emitted=int(emit_np[slot]),
+                    )
+        return tokens_mat, emit_np, finite, drafted, accepted_np, proposed
+
+    def _spec_tree_step(self, window, limits, drafting, spanned):
+        """Tree mode: fork up to ``num_branches`` candidate branches per
+        drafting slot off the draft's top-B FIRST tokens, verify each
+        branch, commit the one the target agrees with longest.
+
+        Page protocol (the order matters): the seed launch runs against the
+        slots' OWN rows first — it writes the pending position's draft K/V
+        into the boundary page — and only THEN are branch rows forked:
+        committed pages below the boundary are ``PageAllocator.fork``ed
+        (refcount, no copy — verify never writes them), the boundary page
+        is COW-copied in BOTH pools (it carries the partial committed page
+        plus the seed's draft K/V), and each branch's tail is fresh pages.
+        Branch rows are transient host arrays; commit swaps the winner's
+        segment into the slot's real table row — which serves both pools in
+        the same motion — and drops every other reference. Allocation
+        pressure drops branches (worst case: branch 0 alone == linear).
+
+        Only one branch's seed can equal the target's first greedy choice
+        (top-B seeds are distinct), so every branch emits a prefix of THE
+        temperature-0 stream and the max-accepted winner (lowest branch on
+        ties) preserves bit-equality."""
+        spec = self.spec
+        B = spec.config.num_branches
+        ps = self.cache.page_size
+        S = self.cache.num_slots
+        drafted = drafting.copy()
+        lengths0 = self.cache.lengths.copy()
+        proposed = 0
+        # seed launch: top-B first candidates, pending-position draft K/V
+        # written through the slots' own rows BEFORE any fork
+        seeds, dok = spec.decode(
+            np.where(drafting, self._pending, 0).astype(np.int32),
+            lengths0.astype(np.int32), drafting, self.cache.tables, top_b=B,
+        )
+        for slot in np.flatnonzero(drafting & ~dok):
+            spec.fail_slot(
+                int(slot), self.cache.tables, int(self.cache.held[slot])
+            )
+            drafting[slot] = False
+            limits[slot] = 1
+        proposed += int(drafting.sum())
+        # fork branch rows: branches[slot] = (idx0, target, rows); rows[0]
+        # is the slot's own row, rows[b>=1] private boundary copy + fresh tail
+        branches: dict[int, tuple[int, int, list[np.ndarray]]] = {}
+        for slot in np.flatnonzero(drafting):
+            slot = int(slot)
+            length = int(lengths0[slot])
+            idx0 = length // ps
+            target = pages_for(length + int(limits[slot]), ps)
+            rows = [self.cache.tables[slot].copy()]
+            committed = [int(p) for p in self.cache.tables[slot, :idx0] if p]
+            src = int(self.cache.tables[slot, idx0])
+            for _ in range(1, B):
+                fresh = self.cache._alloc(target - idx0)
+                if fresh is None:
+                    break  # pressure: fewer branches this step
+                self.cache.pages.fork(committed)
+                row = self.cache.tables[slot].copy()
+                row[idx0:target] = fresh
+                self.cache.k, self.cache.v = self._page_copy_program()(
+                    self.cache.k, self.cache.v, np.int32(src), np.int32(fresh[0])
+                )
+                spec.copy_page(src, fresh[0])
+                self.stats.record_cow_copy()
+                rows.append(row)
+            branches[slot] = (idx0, target, rows)
+        nb = np.zeros((S,), np.int32)
+        for slot, (_, _, rows) in branches.items():
+            nb[slot] = len(rows)
+        bmax = int(nb.max()) if branches else 0
+        wins, tabs, chains = [], [], []
+        for b in range(bmax):
+            tb = self.cache.tables.copy()
+            wb = window.copy()
+            for slot, (_, _, rows) in branches.items():
+                if b < len(rows):
+                    tb[slot] = rows[b]
+                    wb[slot, 1] = seeds[slot, b]
+            wins.append(wb)
+            tabs.append(tb)
+            chains.append(wb[:, 1].copy())
+        # branch chains: launch (i, b) advances branch b of EVERY tree slot
+        for i in range(1, int(limits.max()) if branches else 0):
+            for b in range(bmax):
+                act = drafting & (nb > b) & (i < limits)
+                if not act.any():
+                    continue
+                nxt, dok = spec.decode(
+                    np.where(act, chains[b], 0).astype(np.int32),
+                    (lengths0 + i).astype(np.int32), act, tabs[b],
+                )
+                proposed += int(act.sum())
+                for slot in np.flatnonzero(act & ~dok):
+                    slot = int(slot)
+                    # a branch chain went non-finite: fail the whole slot
+                    # (scrub every branch's draft pages, fall back to the
+                    # bare pending verify) — verify still emits its one
+                    # plain-decode token, so throughput is all that's lost
+                    idx0_, target_, rows = branches[slot]
+                    spec.draft_ok[slot] = False
+                    pages = {
+                        int(r[j]) for r in rows for j in range(idx0_, target_)
+                    }
+                    spec.scrub_pages([p for p in pages if p])
+                    drafting[slot] = False
+                    limits[slot] = 1
+                good = act & dok
+                wins[b][good, i + 1] = nxt[good]
+                chains[b] = np.where(good, nxt, chains[b]).astype(np.int32)
+        if spanned:
+            for slot in spanned:
+                request = self.scheduler.slots[slot]
+                if request is not None:
+                    self.tracer.span_end(request.id, "draft", stats=self.stats)
+                    self.tracer.span_start(
+                        request.id, "verify", replica=self.name,
+                        branches=int(nb[slot]),
+                    )
+        verify = self._spec_verify_program()
+        toks_b, acc_b, emit_b = [], [], []
+        finite = None
+        for b in range(max(bmax, 1)):
+            wb = wins[b] if b < len(wins) else window
+            tb = tabs[b] if b < len(tabs) else self.cache.tables
+            # lanes whose slot has no branch b are masked OFF: their writes
+            # would otherwise re-land through the ORIGINAL row and corrupt
+            # branch 0's committed window K/V
+            act = self.cache.active & ~(drafted & (nb <= b)) if b else self.cache.active
+            toks, accepted, emit, vok, self.cache.k, self.cache.v = verify(
+                self.params, self.cache.k, self.cache.v, wb,
+                self.cache.lengths, act, limits, tb,
+            )
+            toks_b.append(np.asarray(toks))
+            acc_b.append(np.asarray(accepted))
+            emit_b.append(np.asarray(emit))
+            if finite is None:
+                finite = np.asarray(vok)  # launch 0 carries the probe
+        tokens_mat = toks_b[0].copy()
+        emit_np = emit_b[0].copy()
+        accepted_np = acc_b[0].copy()
+        # commit: pick each tree slot's winner, swap its segment in, drop
+        # every branch reference (forked committed refs, loser pages, and —
+        # for a b>=1 winner — the replaced originals)
+        for slot, (idx0, target, rows) in branches.items():
+            nslot = len(rows)
+            accs = [int(acc_b[b][slot]) for b in range(nslot)]
+            win = int(np.argmax(accs)) if drafting[slot] else 0
+            committed = [int(p) for p in rows[0][:idx0] if p]
+            for b in range(1, nslot):
+                for p in committed:
+                    self.cache.pages.decref(p)
+                if b != win:
+                    for j in range(idx0, target):
+                        page = int(rows[b][j])
+                        if page:
+                            self.cache.pages.decref(page)
+            if win > 0:
+                for j in range(idx0, target):
+                    page = int(self.cache.tables[slot, j])
+                    if page:
+                        self.cache.pages.decref(page)
+                self.cache.tables[slot, idx0:target] = rows[win][idx0:target]
+                tokens_mat[slot] = toks_b[win][slot]
+                emit_np[slot] = emit_b[win][slot]
+                accepted_np[slot] = acc_b[win][slot]
+        if spanned:
+            for slot in spanned:
+                request = self.scheduler.slots[slot]
+                if request is not None:
+                    self.tracer.span_end(
+                        request.id, "verify", stats=self.stats,
+                        accepted=int(accepted_np[slot]),
+                        emitted=int(emit_np[slot]),
+                    )
+        return tokens_mat, emit_np, finite, drafted, accepted_np, proposed
 
     # -- the engine loop ---------------------------------------------------
 
@@ -1436,8 +2011,22 @@ class ServingEngine:
         compiles_before = self.compiles.compile_count
         if self._watchdog is not None and self._decode_warm:
             self._watchdog.arm()
+        spec_on = self.spec is not None and self.spec.enabled
+        if spec_on and self.chaos is not None and self.chaos.spec_disable(self._steps):
+            # mid-stream chaos drill: flip to plain decode PERMANENTLY, this
+            # very step — the stream must continue without a drop or dup
+            self.disable_speculation("chaos")
+            spec_on = False
         keys = jax.random.split(jax.random.fold_in(self._rng, self._steps), self.cache.num_slots)
-        if self.paged:
+        drafted = None
+        emit = None
+        if spec_on:
+            # the speculative step REPLACES the plain decode: every active
+            # lane rides the verify program (a non-drafting lane's window is
+            # just its pending token — emit 1, the plain-decode token), and
+            # the quarantine probe rides the target's finite verdict as usual
+            tokens_mat, emit, finite, drafted = self._spec_device_step(active_idx)
+        elif self.paged:
             nxt, ok, self.cache.k, self.cache.v = self._paged_decode_program()(
                 self.params,
                 self.cache.k,
@@ -1448,6 +2037,8 @@ class ServingEngine:
                 self.cache.tables,
                 keys,
             )
+            tokens_mat = np.asarray(nxt)[:, None]  # host fetch = per-step fence
+            finite = np.asarray(ok)
         else:
             nxt, ok, self.cache.k, self.cache.v = self._decode_program()(
                 self.params,
@@ -1458,8 +2049,8 @@ class ServingEngine:
                 self.cache.active,
                 keys,
             )
-        tokens = np.asarray(nxt)  # host fetch = the per-step fence + EOS gate
-        finite = np.asarray(ok)
+            tokens_mat = np.asarray(nxt)[:, None]  # host fetch = per-step fence
+            finite = np.asarray(ok)
         if self._watchdog is not None:
             self._watchdog.disarm()
         self._steps += 1
@@ -1541,6 +2132,12 @@ class ServingEngine:
                         self.cache.k, self.cache.v = self._page_scrub_program()(
                             self.cache.k, self.cache.v, mask
                         )
+                        if self.spec is not None:
+                            # the draft pool recycles the same page ids: its
+                            # copies of the freed pages scrub too (0 × NaN)
+                            self.spec.scrub_pages(freed)
+                    if self.spec is not None:
+                        self.spec.draft_len[slot] = 0
                 else:
                     self.cache.quarantine(slot)
                     self.cache.k, self.cache.v = self._scrub_program()(
@@ -1561,26 +2158,39 @@ class ServingEngine:
                 self._record_degraded(done, slot=slot)
                 finished.append(self._result_for(done))
                 continue
-            delivered += 1
-            token = int(tokens[slot])
-            request.generated.append(token)
-            self.cache.lengths[slot] += 1
-            if request.first_token_at is None:
-                request.first_token_at = now
-                if self.tracer is not None:
-                    self.tracer.event(
-                        request.id, "first_token", stamp=now, replica=self.name
-                    )
-                self.stats.record_first_token(request.ttft_s)
-            hit_eos = self.eos_token_id is not None and token == self.eos_token_id
-            if hit_eos or len(request.generated) >= request.max_new_tokens:
-                self.cache.retire(slot)
-                done = self.scheduler.retire(slot, "eos" if hit_eos else "length")
-                self.stats.record_finish(done.latency_s)
-                finished.append(self._result_for(done))
-            elif request.past_deadline(now):
+            # one token on the plain path; up to `emit[slot]` on the
+            # speculative path — the retire gates (EOS, budget) apply PER
+            # TOKEN in emission order, so a window whose middle token is EOS
+            # retires exactly there and the tail tokens are dropped, byte-
+            # for-byte what plain decode would have produced
+            count = int(emit[slot]) if emit is not None else 1
+            token = 0
+            retired = False
+            for j in range(count):
+                delivered += 1
+                token = int(tokens_mat[slot, j])
+                request.generated.append(token)
+                self.cache.lengths[slot] += 1
+                if request.first_token_at is None:
+                    request.first_token_at = now
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            request.id, "first_token", stamp=now, replica=self.name
+                        )
+                    self.stats.record_first_token(request.ttft_s)
+                hit_eos = self.eos_token_id is not None and token == self.eos_token_id
+                if hit_eos or len(request.generated) >= request.max_new_tokens:
+                    self.cache.retire(slot)
+                    done = self.scheduler.retire(slot, "eos" if hit_eos else "length")
+                    self.stats.record_finish(done.latency_s)
+                    finished.append(self._result_for(done))
+                    retired = True
+                    break
+            if retired:
+                continue
+            if request.past_deadline(now):
                 # the deadline passed during the decode: retiring here (with
-                # the partial output, this step's token included) saves the
+                # the partial output, this step's tokens included) saves the
                 # doomed request one more decode step vs waiting for the
                 # top-of-next-step sweep
                 self.cache.retire(slot)
@@ -1599,6 +2209,21 @@ class ServingEngine:
                 self._resilience({"event": "quarantine_release", "slot": slot})
             else:
                 self._probe_failures[slot] = self._probe_failures.get(slot, 0) + 1
+
+        if drafted is not None:
+            # speculative rollback: every slot that drafted grew its table to
+            # hold the whole window — release the pages the accepted prefix
+            # didn't reach (refcounts drop; tree losers were already dropped
+            # at commit) and advance the draft pool's high-water mark
+            for slot in active_idx:
+                if not drafted[slot]:
+                    continue
+                request = self.scheduler.slots[slot]
+                if request is None or not self.cache.active[slot]:
+                    continue  # retired/quarantined mid-window: pages already released
+                self.cache.trim_to_length(slot)
+                if self.spec.draft_ok[slot]:
+                    self.spec.draft_len[slot] = int(self.cache.lengths[slot])
 
         self.stats.record_step(
             now - t0, active=len(active_idx), waiting=self.scheduler.waiting,
@@ -1825,6 +2450,13 @@ class ServingEngine:
         request.prefilled = length
         self.scheduler.adopt(request, slot)
         self._pending[slot] = prompt[-1]
+        if self.spec is not None:
+            # the handoff moved TARGET K/V only — the draft pool knows
+            # nothing of these pages. draft_len = 0 marks the whole history
+            # for catch-up (mirrored spans rebuild the draft K/V before the
+            # slot's first drafting step)
+            self.spec.draft_ok[slot] = True
+            self.spec.draft_len[slot] = 0
         if self.tracer is not None:
             # a handed-off request joins its (source-opened) trace here: the
             # decode span's replica names the pool that actually streams
@@ -1899,6 +2531,12 @@ class ServingEngine:
         request.prefilled = parked["length"]
         self.scheduler.adopt(request, slot)
         self._pending[slot] = prompt[-1]
+        if self.spec is not None:
+            # src == dst: the parked pages are this engine's own, and their
+            # draft halves were mirrored when the prefill ran here — drafting
+            # can resume immediately (stale mirrors only cost acceptance)
+            self.spec.draft_ok[slot] = True
+            self.spec.draft_len[slot] = parked["length"]
         if self.tracer is not None:
             self.tracer.span_end(
                 request_id, "parked", stats=self.stats, outcome="resumed"
@@ -2034,6 +2672,30 @@ class ServingEngine:
                     **audit_kwargs,
                 )
                 report.merge(sub, prefix="adopt_kv")
+            if self.spec is not None:
+                # the speculative verify program: donation must survive the
+                # window widening, and the page tables/limits must ride as
+                # ARGS — a baked table would recompile per step and a baked
+                # limit would freeze the emit cap into the executable
+                w = self.spec.config.k + 1
+                lowered = self._spec_verify_program().lower(
+                    self.params,
+                    self.cache.k,
+                    self.cache.v,
+                    jax.ShapeDtypeStruct((self.cache.num_slots, w), jnp.int32),
+                    self.cache.lengths,
+                    self.cache.active,
+                    np.ones((self.cache.num_slots,), np.int32),
+                    self.cache.tables,
+                )
+                sub = audit_lowered(
+                    lowered,
+                    compile=False,
+                    label="serving_speculative_verify",
+                    expect_donation=self._donate,
+                    **audit_kwargs,
+                )
+                report.merge(sub, prefix="speculative_verify")
         if contracts_dir is not None:
             from ..analysis.contracts import gate_reports
 

@@ -408,6 +408,29 @@ class PagedKVCache:
         self.pages.decref(page)
         return ("cow", page, dst)
 
+    def trim_to_length(self, slot: int) -> list[int]:
+        """Speculative rollback: drop trailing pages beyond what
+        ``lengths[slot]`` committed positions need. Before a verify step the
+        engine grows the slot far enough to hold the whole candidate window;
+        after acceptance lands short, the surplus pages are released here —
+        refcounts drop (a forked tree branch's surplus simply un-shares;
+        the last holder frees the page back to the pool) and the table's
+        tail re-points at the null page. Returns the pages that became free
+        (candidates for scrubbing only if they ever held non-finite data —
+        speculative windows are ordinary finite K/V, so no scrub here)."""
+        keep = pages_for(int(self.lengths[slot]), self.page_size)
+        held = int(self.held[slot])
+        if keep >= held:
+            return []
+        freed = []
+        for idx in range(keep, held):
+            page = int(self.tables[slot, idx])
+            if page and self.pages.decref(page):
+                freed.append(page)
+            self.tables[slot, idx] = 0
+        self.held[slot] = keep
+        return freed
+
     def _release_pages(self, slot: int) -> list[int]:
         """Drop the slot's references; returns pages that became free."""
         freed = [p for p in self.pages_of(slot) if self.pages.decref(p)]

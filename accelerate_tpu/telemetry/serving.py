@@ -101,6 +101,14 @@ class ServingStats:
         self.span_seconds: dict[str, list[float]] = {}
         self.slo_good_events = 0
         self.slo_bad_events = 0
+        # speculative decoding: proposed/accepted counters sum across the
+        # fleet; accepted lengths are RAW per-step samples (token counts,
+        # not seconds) so the rollup can merge real percentiles
+        self.spec_steps = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_fallbacks = 0
+        self.spec_accepted_lengths: list[int] = []
 
     # -- intake ------------------------------------------------------------
 
@@ -188,6 +196,18 @@ class ServingStats:
             self.slo_good_events += 1
         else:
             self.slo_bad_events += 1
+
+    def record_spec_step(self, proposed: int, accepted_lengths) -> None:
+        """One speculative engine step: ``proposed`` draft tokens offered to
+        the verifier and the per-slot accepted lengths (raw samples, so the
+        fleet rollup can merge real percentiles over token counts)."""
+        self.spec_steps += 1
+        self.spec_proposed_tokens += proposed
+        self.spec_accepted_tokens += int(sum(accepted_lengths))
+        self.spec_accepted_lengths.extend(int(a) for a in accepted_lengths)
+
+    def record_spec_fallback(self) -> None:
+        self.spec_fallbacks += 1
 
     def record_cow_copy(self) -> None:
         self.cow_page_copies += 1
@@ -300,6 +320,16 @@ class ServingStats:
         out["trace_spans"] = self.trace_spans
         out["slo_good_events"] = self.slo_good_events
         out["slo_bad_events"] = self.slo_bad_events
+        out["spec_steps"] = self.spec_steps
+        out["spec_proposed_tokens"] = self.spec_proposed_tokens
+        out["spec_accepted_tokens"] = self.spec_accepted_tokens
+        out["spec_fallbacks"] = self.spec_fallbacks
+        if self.spec_accepted_lengths:
+            # token COUNTS, not durations — _percentiles_ms would mislabel
+            # them as milliseconds, so take the percentiles directly
+            arr = np.asarray(self.spec_accepted_lengths, np.float64)
+            out["spec_accepted_len_p50"] = round(float(np.percentile(arr, 50)), 3)
+            out["spec_accepted_len_p99"] = round(float(np.percentile(arr, 99)), 3)
         out.update(_percentiles_ms(self.step_seconds, "per_token"))
         out.update(_percentiles_ms(self.ttft_seconds, "ttft"))
         out.update(_percentiles_ms(self.latency_seconds, "request_latency"))
@@ -345,7 +375,8 @@ def fleet_rollup(
         "requests_adopted", "handoffs_attempted", "handoffs_retried",
         "handoffs_adopted", "handoff_fallbacks", "handoff_pages_moved",
         "handoff_bytes_moved", "traces_completed", "trace_spans",
-        "slo_good_events", "slo_bad_events",
+        "slo_good_events", "slo_bad_events", "spec_steps",
+        "spec_proposed_tokens", "spec_accepted_tokens", "spec_fallbacks",
     )
     for key in counters:
         out[key] = sum(getattr(s, key) for s in stats_list)
@@ -394,6 +425,13 @@ def fleet_rollup(
     for kind in sorted({k for s in stats_list for k in s.span_seconds}):
         samples = [t for s in stats_list for t in s.span_seconds.get(kind, ())]
         out.update(_percentiles_ms(samples, f"span_{kind}", qs=(50, 99)))
+    spec_lengths = [a for s in stats_list for a in s.spec_accepted_lengths]
+    if spec_lengths:
+        # accepted lengths are token counts — percentile them directly, the
+        # same raw-sample merge as the span durations above
+        arr = np.asarray(spec_lengths, np.float64)
+        out["spec_accepted_len_p50"] = round(float(np.percentile(arr, 50)), 3)
+        out["spec_accepted_len_p99"] = round(float(np.percentile(arr, 99)), 3)
     if roles:
         for role in sorted(set(roles)):
             group = [s for s, r in zip(stats_list, roles) if r == role]

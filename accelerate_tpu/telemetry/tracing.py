@@ -30,6 +30,13 @@ span                      covers
                           are SAMPLED on the tracer cadence (never an extra
                           per-step host sync — the decode fence the engine
                           already pays is the only timestamp source)
+``draft[i]``              one SAMPLED speculative-draft window: the draft
+                          model proposing k candidates for this request,
+                          chain dispatch → last draft-step fence
+``verify[i]``             the paired one-step target verification of that
+                          window; carries ``proposed`` / ``accepted`` /
+                          ``emitted`` so per-request acceptance is readable
+                          straight off the trace
 ``first_token``           instant: TTFT boundary
 ``retired``               instant, terminal: carries the finish reason, which
                           must equal the engine's ``finish_reason``
@@ -58,9 +65,10 @@ from collections import deque
 from typing import Any, Optional
 
 # span kinds that are always indexed (several per trace is the normal case:
-# one per prefill chunk, one per handoff attempt); other kinds index only
-# their repeats (a queued[1] after a failover re-home)
-_INDEXED_KINDS = ("prefill", "handoff_attempt")
+# one per prefill chunk, one per handoff attempt, one per sampled
+# draft/verify window); other kinds index only their repeats (a queued[1]
+# after a failover re-home)
+_INDEXED_KINDS = ("prefill", "handoff_attempt", "draft", "verify")
 
 # trace-id sequence, PROCESS-wide: two tracers sharing one telemetry hub
 # (an engine's and a router's, or two fleets) must never mint the same id —

@@ -1428,6 +1428,163 @@ def bench_serving() -> dict:
     return result
 
 
+def bench_speculative() -> dict:
+    """Speculative decoding (accelerate_tpu/serving/speculative.py): paired
+    on/off runs over the SAME temperature-0 prompt trace, so the json carries
+    the subsystem's whole contract — ``speculative_token_equal`` (the spec
+    engine's tokens are bit-identical to the plain engine's),
+    ``speculative_steady_state_compile_count`` 0 after warmup, the
+    accepted-length histogram, and tokens/step for both engines.
+
+    Two draft legs price the mechanism's range honestly: a *half-depth*
+    randomly-initialized draft (acceptance is weight-dependent; at random
+    init it is near zero, so this leg records the verify path's pure
+    overhead) and an *oracle* self-draft (the target drafting for itself —
+    acceptance saturates at k-1 extra committed tokens per step, the
+    mechanism's ceiling; real trained draft/target pairs land in between).
+    At CPU scale the draft chain runs serially, so even the oracle leg's
+    wall-clock gain is modest — on TPU the draft step is a fraction of the
+    target step and the accepted-length histogram is what prices the win."""
+    import sys
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import build_model
+    from accelerate_tpu.serving import (
+        ServingEngine,
+        SpeculativeConfig,
+        make_prompts,
+        run_offered_load,
+    )
+
+    t0 = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        print(
+            f"[speculative +{time.perf_counter() - t0:7.1f}s] {msg}",
+            file=sys.stderr, flush=True,
+        )
+
+    _reset_state()
+    name = os.environ.get("BENCH_SPEC_MODEL", "llama-tiny")
+    num_slots = int(os.environ.get("BENCH_SPEC_SLOTS", "4"))
+    max_len = int(os.environ.get("BENCH_SPEC_MAX_LEN", "128"))
+    max_new = int(os.environ.get("BENCH_SPEC_MAX_NEW", "24"))
+    n_requests = int(os.environ.get("BENCH_SPEC_REQUESTS", "8"))
+    k = int(os.environ.get("BENCH_SPEC_K", "4"))
+
+    model = build_model(name)
+    params = model.init(jax.random.key(0))
+    if jax.default_backend() != "cpu":
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+            params,
+        )
+    draft = type(model)(
+        model.config.replace(num_layers=max(1, model.config.num_layers // 2))
+    )
+    draft_params = draft.init(jax.random.key(1))
+    p_max = min(48, max_len - max_new - k)
+    prompts = make_prompts(n_requests, model.config.vocab_size, 4, p_max, seed=0)
+
+    def run_engine(spec_cfg):
+        def fresh():
+            return ServingEngine(
+                model, params, num_slots=num_slots, max_len=max_len,
+                page_size=16, speculative=spec_cfg,
+            )
+
+        # jit caches live on the model objects, so the warm engine compiles
+        # for the whole leg; the measurement engine then runs clean and its
+        # own per-engine tracker is the steady-state count
+        warm = fresh()
+        warm.warmup()
+        outs = warm.generate_many(prompts, max_new_tokens=max_new)
+        engine = fresh()
+        point = run_offered_load(engine, prompts, max_new, float("inf"))
+        return engine, outs, point, point["compile_count"]
+
+    _, base_outs, base_point, _ = run_engine(None)
+    _stage("plain baseline done")
+
+    result = {
+        "speculative_model": name,
+        "speculative_k": k,
+        "speculative_requests": n_requests,
+        "speculative_max_new_tokens": max_new,
+        "speculative_plain_throughput_tok_s": base_point["throughput_tokens_per_sec"],
+        "speculative_plain_tokens_per_step": (
+            round(base_point["tokens_generated"] / base_point["steps"], 4)
+            if base_point["steps"] else None
+        ),
+        "speculative_plain_per_token_p50_ms": base_point.get("per_token_p50_ms"),
+    }
+    legs = {
+        "halfdepth": SpeculativeConfig(
+            draft_model=draft, draft_params=draft_params, k=k
+        ),
+        "oracle": SpeculativeConfig(
+            draft_model=model, draft_params=params, k=k
+        ),
+    }
+    for leg, cfg in legs.items():
+        engine, outs, point, steady_compiles = run_engine(cfg)
+        _stage(f"{leg} leg done")
+        equal = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(base_outs, outs)
+        )
+        lengths = engine.stats.spec_accepted_lengths
+        hist = np.bincount(
+            np.asarray(lengths, np.int64), minlength=k
+        ).tolist() if lengths else []
+        proposed = point["spec_proposed_tokens"]
+        result.update(
+            {
+                f"speculative_{leg}_token_equal": bool(equal),
+                f"speculative_{leg}_steady_state_compile_count": steady_compiles,
+                f"speculative_{leg}_throughput_tok_s": point[
+                    "throughput_tokens_per_sec"
+                ],
+                f"speculative_{leg}_tokens_per_step": (
+                    round(point["tokens_generated"] / point["steps"], 4)
+                    if point["steps"] else None
+                ),
+                f"speculative_{leg}_per_token_p50_ms": point.get(
+                    "per_token_p50_ms"
+                ),
+                f"speculative_{leg}_proposed_tokens": proposed,
+                f"speculative_{leg}_accepted_tokens": point["spec_accepted_tokens"],
+                f"speculative_{leg}_acceptance_rate": (
+                    round(point["spec_accepted_tokens"] / proposed, 4)
+                    if proposed else 0.0
+                ),
+                # histogram over EXTRA committed tokens per drafting slot per
+                # step (0..k-1): index i counts steps that gained i tokens
+                f"speculative_{leg}_accepted_len_histogram": hist,
+                f"speculative_{leg}_accepted_len_p50": point.get(
+                    "spec_accepted_len_p50"
+                ),
+                f"speculative_{leg}_accepted_len_p99": point.get(
+                    "spec_accepted_len_p99"
+                ),
+            }
+        )
+    # headline aliases: the cross-leg invariants gates read without a leg name
+    result["speculative_token_equal"] = bool(
+        result["speculative_halfdepth_token_equal"]
+        and result["speculative_oracle_token_equal"]
+    )
+    result["speculative_steady_state_compile_count"] = (
+        result["speculative_halfdepth_steady_state_compile_count"]
+        + result["speculative_oracle_steady_state_compile_count"]
+    )
+    return result
+
+
 def bench_resilience() -> dict:
     """Resilience subsystem cost + degradation sweep (accelerate_tpu/resilience):
 
@@ -2280,6 +2437,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "serving":
         print(json.dumps(bench_serving()))
         return
+    if os.environ.get("BENCH_ONLY") == "speculative":
+        print(json.dumps(bench_speculative()))
+        return
     if os.environ.get("BENCH_ONLY") == "resilience":
         print(json.dumps(bench_resilience()))
         return
@@ -2346,6 +2506,7 @@ def main() -> None:
         ("bigmodel_large_resident", lambda: _bench_subprocess("bigmodel_large_resident"),
          ("bigmodel_large_resident_s_per_token",)),
         ("serving", bench_serving, ()),
+        ("speculative", bench_speculative, ()),
         ("resilience", bench_resilience, ()),
         ("analysis", bench_analysis, ()),
         ("observability", bench_observability, ()),

@@ -56,6 +56,10 @@ REQUIRED_CONTRACTS = {
     "serving_prefill_32",
     "serving_prefill_64",
     "serving_adopt_kv",
+    # speculative decoding: the windowed one-step verify program — donation
+    # intact through the window widening, page tables and per-slot emit
+    # limits as arguments (never baked)
+    "serving_speculative_verify",
     "bert_base_step",
     "llama_125m_fsdp_step",
     # ISSUE 16: the redistribution primitive's chunk-commit stage program —

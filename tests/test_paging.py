@@ -158,6 +158,63 @@ def test_paged_cache_cow_and_pressure_walk(llama):
     assert cache.pages.refcounts[donor] == 1
 
 
+def test_fork_partial_rollback_refcount_cycle(llama):
+    """The speculative tree-branch page protocol, engine-independent: fork a
+    slot's committed pages for a branch, COW off the shared boundary page,
+    grow for the candidate window, roll back over a partially-accepted
+    (page-unaligned) tail, release the branch — every refcount accounted,
+    the pool drains to zero."""
+    from accelerate_tpu.models.generation import resolve_decode_protocol
+
+    model, _ = llama
+    init_cache, _ = resolve_decode_protocol(model)
+    cache = PagedKVCache(init_cache, num_slots=2, max_len=32, page_size=4, num_pages=10)
+    slot = cache.admit([], new_pages=3)
+    cache.lengths[slot] = 10  # unaligned: page 2 holds positions 8-9 only
+    committed = cache.pages_of(slot)
+    assert len(committed) == 3
+
+    # a branch forks the committed prefix: refcount, no copy
+    cache.pages.fork(committed)
+    assert all(cache.pages.is_shared(p) for p in committed)
+
+    # the slot's next write lands in the now-SHARED boundary page -> COW:
+    # the slot moves to a private replacement, the branch keeps the original
+    status, src, dst = cache.prepare_write(slot)
+    assert status == "cow" and src == committed[2]
+    assert int(cache.tables[slot, 2]) == dst
+    assert cache.pages.refcounts[committed[2]] == 1  # the branch's ref
+    assert not cache.pages.is_shared(dst)
+
+    # speculative grow for the candidate window, then acceptance lands short
+    # of the window (9 < 10 committed? no — 9 tokens keep 3 pages): the
+    # surplus window page is PRIVATE and must actually free
+    assert cache.grow(slot, 1)
+    window_page = int(cache.tables[slot, 3])
+    cache.lengths[slot] = 9
+    assert cache.trim_to_length(slot) == [window_page]
+    assert cache.held[slot] == 3
+
+    # rollback BELOW shared coverage un-shares, never frees under the branch
+    cache.lengths[slot] = 4  # keep only page 0
+    freed = cache.trim_to_length(slot)
+    # committed[1] was shared (branch holds it) -> not freed; the COW
+    # replacement dst was private -> freed
+    assert freed == [dst]
+    assert cache.pages.refcounts[committed[1]] == 1
+    assert cache.held[slot] == 1
+
+    # branch release: last holder frees, shared holder just un-shares
+    assert cache.pages.decref(committed[0]) is False  # slot still holds it
+    assert cache.pages.decref(committed[1]) is True
+    assert cache.pages.decref(committed[2]) is True
+
+    # retire the slot: the pool is fully drained — no leaked references
+    cache.retire(slot)
+    assert cache.pages.used_count == 0
+    assert cache.pages.free_count == 9
+
+
 # -- engine: equality, exhaustion, sharing, chunking --------------------------
 
 
