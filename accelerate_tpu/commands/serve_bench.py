@@ -25,6 +25,14 @@ handoff p50/p99. The disaggregation drills
 transfer mid-flight, or SIGKILL a prefill replica with KV parked:
 terminated-exactly-once, fallback count, and goodput retained are the
 drill line.
+
+``--trace-load burst|diurnal`` replaces uniform arrivals with a Poisson
+arrival trace (a 4× flash crowd, or a sinusoidal rate swing), and
+``--autoscale`` pairs it with the pool-autoscaling drill: the same trace
+replays against a fixed-shape fleet and one with a
+:class:`serving.RoleRebalancer` attached, and the report compares sheds and
+TTFT p99 plus the flip/thrash/compile invariants (docs/serving.md,
+"Autoscaling").
 """
 
 from __future__ import annotations
@@ -78,6 +86,24 @@ def register_subcommand(subparsers):
         help="Fleet step the fault fires at (default: max-new-tokens // 2); "
              "for handoff-stall/handoff-loss this is the handoff ATTEMPT "
              "index (default: 0)",
+    )
+    parser.add_argument(
+        "--autoscale", action="store_true",
+        help="Attach the RoleRebalancer (docs/serving.md, 'Autoscaling') and "
+             "run a paired fixed-vs-rebalanced drill under the --trace-load "
+             "arrival trace: the rebalanced fleet flips idle replicas into "
+             "the starved pool mid-burst and the report compares sheds and "
+             "TTFT p99. Needs disaggregated pools and --trace-load",
+    )
+    parser.add_argument(
+        "--trace-load", choices=("burst", "diurnal"), default=None,
+        help="Replace the saturation point's all-at-once arrivals with a "
+             "Poisson arrival trace: 'burst' is a 4x flash crowd mid-trace, "
+             "'diurnal' a sinusoidal rate swing (serving/loadgen.py)",
+    )
+    parser.add_argument(
+        "--trace-load-rps", type=float, default=8.0,
+        help="Base request rate (req/s) for --trace-load arrivals",
     )
     parser.add_argument(
         "--mixed", action="store_true",
@@ -211,6 +237,14 @@ def run(args) -> int:
     if disagg and args.no_paged:
         print("disaggregated serving relays page-granular KV — drop --no-paged")
         return 1
+    if args.autoscale and not disagg:
+        print("--autoscale rebalances between pools — set --prefill-replicas "
+              "and --decode-replicas")
+        return 1
+    if args.autoscale and args.trace_load is None:
+        print("--autoscale drills against an arrival trace — add "
+              "--trace-load burst|diurnal")
+        return 1
 
     model = build_model(args.model)
     params = model.init(jax.random.key(args.seed))
@@ -335,7 +369,7 @@ def run(args) -> int:
         engine.telemetry = hub
         return engine
 
-    def fresh_target(fault_plan=None):
+    def fresh_target(fault_plan=None, autoscale=None):
         if n_replicas == 1 and not disagg:
             return fresh_engine()
         kwargs = {}
@@ -348,7 +382,7 @@ def run(args) -> int:
         return ServingRouter(
             engine_factory=fresh_engine, num_replicas=n_replicas,
             roles=roles, fault_plan=fault_plan, tracer=tracer,
-            telemetry=hub, **kwargs,
+            telemetry=hub, autoscale=autoscale, **kwargs,
         )
 
     def fleet_fault_plan():
@@ -378,6 +412,50 @@ def run(args) -> int:
         for rate in args.offered_load
     ]
     points.append(run_offered_load(fresh_target(), prompts, args.max_new_tokens, math.inf))
+
+    # -- arrival-trace window (+ the paired autoscale drill) -----------------
+    autoscale_drill = None
+    trace_point = None
+    if args.trace_load is not None:
+        from ..serving import make_burst_trace, make_diurnal_trace
+
+        maker = make_burst_trace if args.trace_load == "burst" else make_diurnal_trace
+        arrivals = maker(args.requests, args.trace_load_rps, seed=args.seed)
+        trace_point = run_offered_load(
+            fresh_target(), prompts, args.max_new_tokens, arrival_times=arrivals
+        )
+        if args.autoscale:
+            from ..serving import AutoscalePolicy, RoleRebalancer
+
+            # drill-tuned hysteresis: the trace is seconds long, so the
+            # dwell/cooldown windows shrink to fleet-step scale — the
+            # production defaults would out-wait the whole trace. Cooldown
+            # outlasts the 2x-dwell thrash window, so thrash stays 0 by
+            # construction even if the trace's tail argues for a reversal
+            rebalancer = RoleRebalancer(
+                policy=AutoscalePolicy(
+                    cadence_steps=2, min_dwell_steps=8, cooldown_steps=20
+                )
+            )
+            rebalanced = run_offered_load(
+                fresh_target(autoscale=rebalancer), prompts, args.max_new_tokens,
+                arrival_times=arrivals,
+            )
+            autoscale_drill = {
+                "trace": args.trace_load,
+                "base_rps": args.trace_load_rps,
+                "fixed_sheds": trace_point["loadgen_sheds"],
+                "rebalanced_sheds": rebalanced["loadgen_sheds"],
+                "fixed_ttft_p99_ms": trace_point["loadgen_ttft_p99_ms"],
+                "rebalanced_ttft_p99_ms": rebalanced["loadgen_ttft_p99_ms"],
+                "fixed_completed": trace_point["requests_completed"],
+                "rebalanced_completed": rebalanced["requests_completed"],
+                "flip_count": rebalanced["autoscale_flip_count"],
+                "thrash_count": rebalanced["autoscale_thrash_count"],
+                "aborted_flips": rebalanced["autoscale_aborted_flips"],
+                "fail_static_count": rebalanced["autoscale_fail_static_count"],
+                "steady_state_compile_count": rebalanced["compile_count"],
+            }
 
     drill = None
     # traces_completed is MONOTONIC (the deque it feeds is bounded): the
@@ -475,6 +553,14 @@ def run(args) -> int:
         "steady_state_compile_count": points[-1]["compile_count"],
         "sweep": points,
     }
+    if trace_point is not None:
+        payload["load_trace"] = {
+            "kind": args.trace_load,
+            "base_rps": args.trace_load_rps,
+            "point": trace_point,
+        }
+    if autoscale_drill is not None:
+        payload["autoscale_drill"] = autoscale_drill
     if tracer is not None:
         payload["trace"] = {
             "traces_completed": tracer.traces_completed,
@@ -576,6 +662,25 @@ def run(args) -> int:
             f"({sat.get('handoff_bytes_moved', 0) / 1e6:.1f} MB) moved, "
             f"handoff p50 {sat.get('handoff_p50_ms', 0):.1f}ms / "
             f"p99 {sat.get('handoff_p99_ms', 0):.1f}ms"
+        )
+    if trace_point is not None:
+        print(
+            f"load trace ({args.trace_load} @ {args.trace_load_rps:g} req/s base): "
+            f"{trace_point['loadgen_sheds']} sheds, "
+            f"ttft p50 {trace_point['loadgen_ttft_p50_ms'] or 0:.1f}ms / "
+            f"p99 {trace_point['loadgen_ttft_p99_ms'] or 0:.1f}ms, "
+            f"{trace_point['requests_completed']}/{trace_point['offered_requests']} completed"
+        )
+    if autoscale_drill is not None:
+        a = autoscale_drill
+        print(
+            f"autoscale drill: sheds {a['fixed_sheds']} fixed -> "
+            f"{a['rebalanced_sheds']} rebalanced, "
+            f"ttft p99 {a['fixed_ttft_p99_ms'] or 0:.1f}ms -> "
+            f"{a['rebalanced_ttft_p99_ms'] or 0:.1f}ms, "
+            f"{a['flip_count']} flip(s), {a['thrash_count']} thrash (must be 0), "
+            f"{a['aborted_flips']} aborted, "
+            f"{a['steady_state_compile_count']} steady-state compiles (must be 0)"
         )
     if drill is not None:
         retained = drill["goodput_retained"]

@@ -63,6 +63,17 @@ Fault legs:
   KV-handoff page transfers all count) mid-transfer. The primitive's ladder
   must degrade staged → host relay with the source intact, or fail loud
   NAMING the stage when the fallback is pinned off;
+- ``rebalance_fail_at`` — the autoscale drill (serving/autoscale.py): kill
+  the donor replica of the chosen role FLIPS (0-based flip indices,
+  fleet-wide) right after its drain-safe transition begins — mid-flip, the
+  window where a real autoscaler loses a node. The rebalancer must abort
+  the transition and the router's ordinary death machinery must re-home
+  everything: no livelock, no stranded parked KV, no lost request;
+- ``autoscale_outage_step`` / ``autoscale_outage_duration`` — the
+  signal-outage drill: from the chosen fleet step on (for ``duration``
+  fleet steps; 0 = persistent), the rebalancer's telemetry signal source is
+  unreadable — the fail-static rung must FREEZE the fleet's current shape
+  and record why, never taking the fleet down with the telemetry store;
 - ``spec_disable_step`` — the speculative-decoding drill
   (serving/speculative.py): at the chosen serving step the engine's draft
   model is declared gone and speculation disables PERMANENTLY mid-stream —
@@ -145,6 +156,15 @@ class FaultPlan:
     # index selects WHICH stage of the decomposition dies mid-transfer
     redistribute_fail_at: tuple[int, ...] = ()
     redistribute_fail_stage: int = 0
+    # autoscale faults (serving/autoscale.py): rebalance_fail_at counts role
+    # FLIPS (0-based, per-rebalancer flip sequence) whose donor replica is
+    # killed mid-flip; the outage leg makes the rebalancer's signal source
+    # unreadable from the chosen fleet step for `duration` steps (0 =
+    # persistent) — the fail-static rung, not this hook, decides what
+    # happens next
+    rebalance_fail_at: tuple[int, ...] = ()
+    autoscale_outage_step: Optional[int] = None
+    autoscale_outage_duration: int = 0
     # speculative-decoding fault: the serving step (0-based, engine._steps
     # BEFORE the step) at which speculation is disabled MID-STREAM — the
     # drill asserts the engine falls back to plain decode without dropping
@@ -160,6 +180,7 @@ class FaultPlan:
     _host_loss_fired: bool = field(default=False, repr=False)
     _membership_silence_recorded: bool = field(default=False, repr=False)
     _membership_stall_recorded: bool = field(default=False, repr=False)
+    _autoscale_outage_recorded: bool = field(default=False, repr=False)
 
     def __post_init__(self):
         if self.nan_target not in ("grads", "loss"):
@@ -181,6 +202,7 @@ class FaultPlan:
         ms_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_SILENCE_STEP")
         mst_step = env.get("ACCELERATE_CHAOS_MEMBERSHIP_STALL_STEP")
         spec_step = env.get("ACCELERATE_CHAOS_SPEC_DISABLE_STEP")
+        outage_step = env.get("ACCELERATE_CHAOS_AUTOSCALE_OUTAGE_STEP")
         return cls(
             seed=int(env.get("ACCELERATE_CHAOS_SEED", "0")),
             nan_steps=_parse_steps(env.get("ACCELERATE_CHAOS_NAN_STEPS")),
@@ -215,6 +237,11 @@ class FaultPlan:
             redistribute_fail_stage=int(
                 env.get("ACCELERATE_CHAOS_REDISTRIBUTE_FAIL_STAGE", "0")
             ),
+            rebalance_fail_at=_parse_steps(env.get("ACCELERATE_CHAOS_REBALANCE_FAIL_AT")),
+            autoscale_outage_step=int(outage_step) if outage_step else None,
+            autoscale_outage_duration=int(
+                env.get("ACCELERATE_CHAOS_AUTOSCALE_OUTAGE_DURATION", "0")
+            ),
             spec_disable_step=int(spec_step) if spec_step else None,
         )
 
@@ -235,6 +262,8 @@ class FaultPlan:
             or self.handoff_stall_at
             or self.handoff_loss_at
             or self.redistribute_fail_at
+            or self.rebalance_fail_at
+            or self.autoscale_outage_step is not None
             or self.spec_disable_step is not None
         )
 
@@ -402,6 +431,38 @@ class FaultPlan:
             self._record("handoff_loss", attempt=attempt)
             return True
         return False
+
+    def rebalance_fail(self, flip: int, valid=None) -> bool:
+        """Whether the donor replica of role flip ``flip`` (0-based, the
+        rebalancer's own flip sequence) dies mid-flip — fired by the
+        rebalancer right after the donor's drain-safe transition begins,
+        the window where a real autoscaler loses a node. ``valid`` (the
+        rebalancer's check: donor still alive) gates the injection before
+        it is recorded, like the fleet hooks."""
+        if flip in self.rebalance_fail_at:
+            if valid is not None and not valid(flip):
+                return False
+            self._record("rebalance_fail", flip=flip)
+            return True
+        return False
+
+    def autoscale_outage(self, fleet_step: int) -> bool:
+        """Whether the rebalancer's signal source is unreadable at this
+        fleet step. PERSISTENT from the armed step (bounded by
+        ``autoscale_outage_duration`` when non-zero); the ledger records the
+        onset once — the fail-static rung is expected to hold for the whole
+        outage, not re-enter per step."""
+        if self.autoscale_outage_step is None or fleet_step < self.autoscale_outage_step:
+            return False
+        if (
+            self.autoscale_outage_duration
+            and fleet_step >= self.autoscale_outage_step + self.autoscale_outage_duration
+        ):
+            return False
+        if not self._autoscale_outage_recorded:
+            self._autoscale_outage_recorded = True
+            self._record("autoscale_outage", step=fleet_step)
+        return True
 
     def redistribute_fail(self, transfer: int, stage: int, kind: str) -> bool:
         """Whether stage ``stage`` of redistribute transfer ``transfer``

@@ -16,10 +16,15 @@ chips. ``speculative.py`` adds draft-model speculative decoding on top of
 the paged engine: a small draft proposes k tokens against its own paged KV
 pool (sharing the engine's page tables), the target verifies the whole
 window in ONE decode step, and tree mode forks shared prefix pages by
-refcount to race several candidate branches. Later serving work
-(multi-host serve meshes) builds on these pieces.
+refcount to race several candidate branches. ``autoscale.py`` closes the loop
+on fleet SHAPE: a :class:`RoleRebalancer` the router steps on a cadence
+reads the signals the fleet already publishes and flips replicas between
+starved and idle pools through the drain-safe machinery — with hysteresis
+against thrash and a fail-static rung when its own signals degrade. Later
+serving work (multi-host serve meshes) builds on these pieces.
 """
 
+from .autoscale import AutoscalePolicy, RoleRebalancer, fleet_signals
 from .engine import (
     ServingEngine,
     ServingResult,
@@ -43,13 +48,20 @@ from .kv_cache import (
     paged_kv_cache_bytes,
     prefill_buckets,
 )
-from .loadgen import make_mixed_prompts, make_prompts, run_offered_load
+from .loadgen import (
+    make_burst_trace,
+    make_diurnal_trace,
+    make_mixed_prompts,
+    make_prompts,
+    run_offered_load,
+)
 from .paging import PageAllocator, PagedKVCache, PrefixCache, pages_for
 from .router import RoutedRequest, ServingRouter
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request
 from .speculative import SpeculativeConfig
 
 __all__ = [
+    "AutoscalePolicy",
     "ContinuousBatchingScheduler",
     "EngineReplica",
     "HandoffLost",
@@ -62,6 +74,7 @@ __all__ = [
     "ReplicaLost",
     "ReplicaState",
     "Request",
+    "RoleRebalancer",
     "RoutedRequest",
     "ServingEngine",
     "ServingResult",
@@ -71,7 +84,10 @@ __all__ = [
     "SpeculativeConfig",
     "StepWatchdog",
     "bucket_for",
+    "fleet_signals",
     "kv_cache_bytes",
+    "make_burst_trace",
+    "make_diurnal_trace",
     "make_mixed_prompts",
     "make_prompts",
     "paged_kv_cache_bytes",

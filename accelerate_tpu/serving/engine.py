@@ -382,6 +382,10 @@ class ServingEngine:
             num_pages=self.cache.num_pages if paged else None,
             page_size=page_size if paged else None,
         )
+        # wait-quote baseline (reset_service_estimate): quotes price from
+        # stats deltas past this snapshot, so a role flip can discard the
+        # old role's service rates without touching the telemetry counters
+        self._quote_base = (0, 0.0, 0, 0)
         if telemetry is not None:
             self.compiles = telemetry.compiles
         else:
@@ -855,6 +859,10 @@ class ServingEngine:
         (and the page-occupancy signals built on it) held by K/V no real
         traffic will ever reuse."""
         self._warming = True
+        # warmup traffic is internal — one request per bucket must enqueue
+        # even on engines whose admission cap is smaller than the bucket
+        # count, so the cap lifts for the duration
+        cap, self.scheduler.max_queue = self.scheduler.max_queue, None
         try:
             for i, bucket in enumerate(self.buckets):
                 length = min(bucket + 1, self.cache.max_len)
@@ -919,6 +927,7 @@ class ServingEngine:
                         zeros, inactive, self.cache.tables, keys,
                     )
         finally:
+            self.scheduler.max_queue = cap
             self._warming = False
 
     @property
@@ -1110,18 +1119,58 @@ class ServingEngine:
             ]
         return payloads
 
+    def reset_service_estimate(self) -> None:
+        """Forget the service-rate history the retry/drain quotes are built
+        on; the cumulative telemetry counters are untouched. A role flip
+        calls this: a decode replica's measured tokens-per-request and step
+        time say nothing about its new life as a prefill-pool member, and
+        quoting its queue from them underprices the wait badly enough that
+        well-behaved clients turn into a retry storm. After the reset the
+        quotes fall back to the conservative no-history prior until the new
+        role's rates are measured."""
+        s = self.stats
+        self._quote_base = (
+            s.steps, s.decode_seconds, s.tokens_generated, s.requests_completed,
+        )
+
+    def _service_rates(self) -> tuple[float, float]:
+        """(mean step seconds, mean tokens per completed request) since the
+        last ``reset_service_estimate`` — the inputs every wait quote is
+        priced from. Conservative defaults before any history exists."""
+        s = self.stats
+        base_steps, base_seconds, base_tokens, base_completed = self._quote_base
+        steps = s.steps - base_steps
+        mean_step = ((s.decode_seconds - base_seconds) / steps) if steps else 0.01
+        completed = s.requests_completed - base_completed
+        mean_tokens = (
+            (s.tokens_generated - base_tokens) / completed if completed else 16.0
+        )
+        return mean_step, mean_tokens
+
     def retry_after_hint(self) -> float:
         """Estimated seconds until a queue position frees: the backlog drains
         in waves of ``num_slots`` requests, each wave lasting roughly (mean
         tokens per request) × (mean decode-step time). Before any history
         exists, a conservative small constant."""
-        s = self.stats
-        mean_step = (s.decode_seconds / s.steps) if s.steps else 0.01
-        mean_tokens = (
-            s.tokens_generated / s.requests_completed if s.requests_completed else 16.0
-        )
+        mean_step, mean_tokens = self._service_rates()
         waves = math.ceil((self.scheduler.waiting + 1) / self.cache.num_slots)
         return round(max(waves * mean_tokens * mean_step, mean_step), 4)
+
+    def drain_eta_hint(self) -> float:
+        """Estimated seconds until this engine's ACTIVE slots all finish —
+        the honest wait quote for a DRAINING replica. ``retry_after_hint``
+        prices one freed queue position, but a draining replica's freed
+        positions are not admissible: nothing lands here until every active
+        slot runs to completion (and, for a role flip, the replica
+        re-enters), so the router's shed hint prices draining replicas with
+        this full-drain estimate instead of the optimistic per-position
+        one."""
+        mean_step, _ = self._service_rates()
+        remaining = 0
+        for slot in self.scheduler.active_slots:
+            request = self.scheduler.slots[slot]
+            remaining = max(remaining, request.max_new_tokens - len(request.generated))
+        return round(max(remaining * mean_step, mean_step), 4)
 
     def _free_slot(self, request: Request):
         """The ``admit_ready`` callback: claim capacity for one queued

@@ -246,6 +246,32 @@ class EngineReplica:
             raise ValueError(f"replica {self.index} is {self.state.value}, cannot drain")
         self._transition(ReplicaState.DRAINING, reason)
 
+    def finish_flip(self, role: str) -> None:
+        """Complete a drain-safe role flip (serving/autoscale.py): a DRAINING
+        replica that ran empty re-enters placement under ``role`` — same
+        engine, same compiled programs, same page pool, so the flip costs
+        zero recompiles. The rebalancer (not this module) is responsible for
+        only calling this once the engine is idle with nothing parked; the
+        guard here is the state machine's, not the drain's."""
+        if self.state is not ReplicaState.DRAINING:
+            raise ValueError(
+                f"replica {self.index} is {self.state.value}, not draining — "
+                "only a drained replica can re-enter under a new role"
+            )
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"role must be one of {REPLICA_ROLES}, got {role!r}")
+        self.role = role
+        self.engine.resume_admission()
+        # the old role's measured service rates would misprice the new
+        # role's queue (a decode history underquotes chunked prefill by an
+        # order of magnitude — enough to turn backed-off clients into a
+        # retry storm): quotes restart from the conservative prior
+        self.engine.reset_service_estimate()
+        self._degraded_events = 0
+        self._clean_steps = 0
+        self.last_progress = time.monotonic()
+        self._transition(ReplicaState.HEALTHY, f"role flip to {role} complete")
+
     def mark_dead(self, reason: str) -> None:
         """SIGKILL semantics: from here the engine object must be treated as
         unreachable — in-flight recovery uses the router's bookkeeping."""

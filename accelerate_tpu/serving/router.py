@@ -133,6 +133,7 @@ class ServingRouter:
         max_failovers: int = 2,
         handoff_timeout_s: Optional[float] = 5.0,
         handoff_retry: Any = None,
+        autoscale: Any = None,
     ):
         if engines is None:
             if engine_factory is None or num_replicas is None:
@@ -206,8 +207,21 @@ class ServingRouter:
         self._retired: list[ServingResult] = []  # terminal results made HERE
         self._drain_moved: dict[int, int] = {}  # re-home counts per drain
         self._steps = 0
+        # policy-driven pool autoscaling (serving/autoscale.py): stepped once
+        # per fleet step; None (the default) keeps the fleet's shape fixed —
+        # and its telemetry/metrics schema byte-identical to a fleet from
+        # before the rebalancer existed
+        self.autoscale = autoscale
+        if autoscale is not None:
+            autoscale.attach(self)
         # fleet counters (the rollup adds per-engine sums on top)
         self.router_sheds = 0
+        self.router_deadline_sheds = 0  # early-shed: wait exceeds deadline budget
+        # sheds attributed to the phase whose pool turned the request away —
+        # the autoscaler's "traffic you cannot serve" signal (fleet_signals):
+        # an instantaneous occupancy sample can look calm between steps while
+        # every burst arrival sheds, but a shed is unfakeable demand
+        self.sheds_by_phase = {"prefill": 0, "decode": 0}
         self.failovers = 0
         self.failed_failovers = 0
         self.rehomed = 0
@@ -244,10 +258,14 @@ class ServingRouter:
             if not alive:
                 raise ReplicaLost("no live replicas — the fleet is down")
             # same shed as the all-full branch below — counted, recorded,
-            # and priced the same way (a draining replica still frees queue
-            # positions, so its hint is the honest wait estimate)
+            # and priced the same way. The quote must NOT use a draining
+            # replica's optimistic per-position hint: its freed queue
+            # positions are not admissible (nothing lands there until the
+            # drain — or role flip — completes), so _quoted_hint prices
+            # draining replicas at their full drain ETA instead.
             self.router_sheds += 1
-            hint = min(r.engine.retry_after_hint() for r in alive)
+            self.sheds_by_phase["prefill"] += 1
+            hint = self._quoted_hint(alive)
             depth = sum(r.engine.scheduler.waiting for r in alive)
             self._fleet_record(
                 {"event": "shed", "reason": "no_placeable", "queue_depth": depth,
@@ -258,8 +276,27 @@ class ServingRouter:
                 queue_depth=depth,
                 retry_after_s=hint,
             )
+        # deadline-aware admission: a request whose estimated queue wait
+        # already exceeds its remaining deadline budget would be admitted,
+        # burn a prefill, and expire — wasted work that steepens the
+        # overload spiral. The gate only fires where the request would
+        # actually wait (a backlogged replica): an idle replica serves
+        # immediately, whatever the hint formula says.
+        remaining = None
+        if rr.deadline_at is not None:
+            remaining = rr.deadline_at - time.perf_counter()
+        admissible = 0
+        deadline_skipped = 0
         for replica in candidates:
             if not replica.engine.queue_available:
+                continue
+            admissible += 1
+            if (
+                remaining is not None
+                and replica.engine.scheduler.waiting > 0
+                and replica.engine.retry_after_hint() > remaining
+            ):
+                deadline_skipped += 1
                 continue
             # ValueError (prompt the fleet can never serve) propagates —
             # every replica shares one shape config, so the first verdict
@@ -279,11 +316,30 @@ class ServingRouter:
             self.placements[replica.index] += 1
             self._inflight[rr.id] = rr
             return rr.id
-        # every placeable replica is full: the router-level shed, priced at
-        # the soonest any replica expects to free a queue position
         self.router_sheds += 1
+        self.sheds_by_phase["prefill"] += 1
         hint = min(r.engine.retry_after_hint() for r in candidates)
         depth = sum(r.engine.scheduler.waiting for r in candidates)
+        if admissible and deadline_skipped == admissible:
+            # every replica that COULD queue this request would hold it past
+            # its deadline: shed now, before a prefill is burned. Priced
+            # separately — an operator must be able to tell capacity sheds
+            # from deadline sheds, they call for different fixes.
+            self.router_deadline_sheds += 1
+            self._fleet_record(
+                {"event": "shed", "reason": "deadline", "queue_depth": depth,
+                 "retry_after_s": hint, "deadline_s": rr.deadline_s,
+                 "remaining_s": round(remaining, 4)}
+            )
+            raise QueueFull(
+                f"deadline-aware admission: the soonest queue position "
+                f"(~{hint:.3f}s) exceeds the request's remaining deadline "
+                f"budget ({remaining:.3f}s)",
+                queue_depth=depth,
+                retry_after_s=hint,
+            )
+        # every placeable replica is full: the router-level shed, priced at
+        # the soonest any replica expects to free a queue position
         self._fleet_record(
             {"event": "shed", "queue_depth": depth, "retry_after_s": hint}
         )
@@ -292,6 +348,23 @@ class ServingRouter:
             queue_depth=depth,
             retry_after_s=hint,
         )
+
+    def _quoted_hint(self, replicas: Sequence[EngineReplica]) -> float:
+        """The shed quote: minimum expected wait across ``replicas``, with
+        DRAINING replicas priced at their full drain ETA
+        (:meth:`~.engine.ServingEngine.drain_eta_hint`) rather than the
+        optimistic one-queue-position ``retry_after_hint`` — a draining
+        replica admits nothing until it finishes, so quoting its
+        per-position hint under-quotes the wait during exactly the
+        transitions a drain or an autoscale role flip creates. DEAD
+        replicas never reach here (callers pass alive sets)."""
+        hints = []
+        for r in replicas:
+            if r.state is ReplicaState.DRAINING or r.engine.draining:
+                hints.append(r.engine.drain_eta_hint())
+            else:
+                hints.append(r.engine.retry_after_hint())
+        return min(hints)
 
     def cancel(self, request_id: int) -> bool:
         """Fleet-wide cancellation: wherever the request lives — a replica's
@@ -367,6 +440,12 @@ class ServingRouter:
                     continue
                 self._inflight.pop(result.request_id, None)
                 results.append(result)
+        # autoscale hook BEFORE the drained sweep: a replica draining for a
+        # role flip that just ran empty must be flipped back to placement by
+        # the rebalancer's settle pass — the sweep below would otherwise read
+        # it as an ordinary finished drain and mark it DEAD
+        if self.autoscale is not None:
+            self.autoscale.on_fleet_step(self)
         for replica in self.replicas:
             if (
                 replica.state is ReplicaState.DRAINING
@@ -1047,6 +1126,11 @@ class ServingRouter:
         out["compile_count"] = max(r.engine.compiles.compile_count for r in self.replicas)
         out["fleet_steps"] = self._steps
         out["router_sheds"] = self.router_sheds
+        out["router_deadline_sheds"] = self.router_deadline_sheds
+        if self.autoscale is not None:
+            # gain-only schema: a fleet built without a rebalancer emits
+            # byte-identical metrics to one from before autoscaling existed
+            out.update(self.autoscale.snapshot())
         out["failovers"] = self.failovers
         out["failed_failovers"] = self.failed_failovers
         out["rehomed"] = self.rehomed

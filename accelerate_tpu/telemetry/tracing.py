@@ -282,13 +282,19 @@ class RequestTracer:
         stamp: Optional[float] = None,
         stats: Any = None,
         replica: Optional[str] = None,
+        observe_slo: bool = True,
         **args,
     ) -> Optional[dict]:
         """Terminal: close every open span, append the ``retired`` instant
         (whose ``reason`` is the engine's ``finish_reason``), flush the
         completed record, and feed the SLO monitor. Exactly-once by
         construction — the trace is popped, so a second retire for the same
-        id is a no-op and no request can ever own two span trees."""
+        id is a no-op and no request can ever own two span trees.
+
+        ``observe_slo=False`` keeps the trace out of SLO classification —
+        for infrastructure traces (an autoscale role flip's ``role_flip``
+        span) that are not requests: grading one against a TTFT objective
+        would burn error budget on a trace that never had a first token."""
         trace = self._traces.pop(request_id, None)
         if trace is None:
             return None
@@ -333,7 +339,7 @@ class RequestTracer:
             stats.record_trace_completed()
         if self.telemetry is not None:
             self.telemetry.write_record("trace", record)
-        if self.slo is not None:
+        if self.slo is not None and observe_slo:
             self.slo.observe(record, stats=stats, stamp=t)
         return record
 

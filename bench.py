@@ -2381,6 +2381,117 @@ def bench_analysis() -> dict:
     return result
 
 
+def bench_autoscale() -> dict:
+    """Pool autoscaling under a flash crowd (serving/autoscale.py): the SAME
+    burst Poisson trace replays against two disaggregated fleets — one with
+    the fixed shape it was built with, one with a :class:`RoleRebalancer`
+    attached — and the paired window is the value claim: the rebalanced
+    fleet flips idle decode replicas into the starved prefill pool
+    mid-burst and must shed less and hold a lower TTFT p99. The load is
+    prefill-BOUND by construction (chunked prefill makes every admission a
+    multi-step job while decodes stay short) and the burst is a clump (the
+    multiplier collapses the middle of the trace into a near-simultaneous
+    flash crowd), so saturation is structural — clump size against
+    admission capacity — not a race against the machine's step speed. The
+    invariants ride along: ``autoscale_thrash_count`` must be 0 (hysteresis
+    held against the burst's edges) and the steady-state compile count must
+    be 0 (a flip reuses the engine's compiled programs — the fleet reshapes
+    without a single recompile)."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import build_model
+    from accelerate_tpu.serving import (
+        AutoscalePolicy,
+        RoleRebalancer,
+        ServingEngine,
+        ServingRouter,
+        make_burst_trace,
+        make_prompts,
+        run_offered_load,
+    )
+
+    t0 = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        print(f"[autoscale +{time.perf_counter() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+    _reset_state()
+    name = os.environ.get("BENCH_AUTOSCALE_MODEL", "llama-125m")
+    num_slots = int(os.environ.get("BENCH_AUTOSCALE_SLOTS", "2"))
+    max_new = int(os.environ.get("BENCH_AUTOSCALE_MAX_NEW", "4"))
+    n_requests = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "48"))
+    base_rps = float(os.environ.get("BENCH_AUTOSCALE_BASE_RPS", "8"))
+    burst_multiplier = float(os.environ.get("BENCH_AUTOSCALE_BURST", "200"))
+
+    model = build_model(name)
+    params = model.init(jax.random.key(0))
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+    # prefill-heavy traffic against a prefill-light fleet: long prompts
+    # chunked into multi-step prefills, short decodes, one prefill replica
+    # vs three decode replicas — the flash crowd starves exactly the pool
+    # the rebalancer can feed, and keeps starving it after the first flip
+    prompts = make_prompts(n_requests, model.config.vocab_size, 96, 160, seed=0)
+    max_len = max(p.size for p in prompts) + max_new
+    arrivals = make_burst_trace(
+        n_requests, base_rps, burst_multiplier=burst_multiplier, seed=0
+    )
+
+    def fleet(autoscale=None):
+        return ServingRouter(
+            engine_factory=lambda: ServingEngine(
+                model, params, num_slots=num_slots, max_len=max_len,
+                max_queue=2, prefill_chunk=32,
+            ),
+            num_replicas=4,
+            roles=["prefill", "decode", "decode", "decode"],
+            autoscale=autoscale,
+        )
+
+    # warmup on a throwaway fleet: the jit cache lives on the model, so both
+    # measured windows run on FRESH fleets whose own compile counts start at
+    # (and must stay) zero
+    fleet().warmup()
+    _stage("warmup done")
+    fixed = run_offered_load(fleet(), prompts, max_new, arrival_times=arrivals)
+    _stage("fixed-shape window done")
+    # drill-tuned: dwell/cooldown shrink to fleet-step scale, with cooldown
+    # held past the 2x-dwell thrash window so a late legitimate reversal can
+    # never read as thrash — the invariant stays assertable at exactly 0
+    rebalancer = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=2, min_dwell_steps=8, cooldown_steps=20)
+    )
+    rebalanced_fleet = fleet(autoscale=rebalancer)
+    rebalanced = run_offered_load(rebalanced_fleet, prompts, max_new, arrival_times=arrivals)
+    _stage("rebalanced window done")
+
+    return {
+        "autoscale_model": name,
+        "autoscale_requests": n_requests,
+        "autoscale_base_rps": base_rps,
+        "autoscale_burst_multiplier": burst_multiplier,
+        "autoscale_fixed_sheds": fixed["loadgen_sheds"],
+        "autoscale_rebalanced_sheds": rebalanced["loadgen_sheds"],
+        "autoscale_fixed_ttft_p50_ms": fixed["loadgen_ttft_p50_ms"],
+        "autoscale_rebalanced_ttft_p50_ms": rebalanced["loadgen_ttft_p50_ms"],
+        "autoscale_fixed_ttft_p99_ms": fixed["loadgen_ttft_p99_ms"],
+        "autoscale_rebalanced_ttft_p99_ms": rebalanced["loadgen_ttft_p99_ms"],
+        "autoscale_fixed_completed": fixed["requests_completed"],
+        "autoscale_rebalanced_completed": rebalanced["requests_completed"],
+        "autoscale_flip_count": rebalanced["autoscale_flip_count"],
+        "autoscale_thrash_count": rebalanced["autoscale_thrash_count"],
+        "autoscale_aborted_flips": rebalanced["autoscale_aborted_flips"],
+        # the flip must reuse the engines' compiled programs: the measured
+        # window (warmup covered every bucket on a throwaway fleet) compiles
+        # nothing even while the fleet reshapes itself
+        "autoscale_steady_state_compile_count": rebalanced["compile_count"],
+    }
+
+
 def _bench_subprocess(which: str, timeout: float = 1500) -> dict:
     """Run a big-model bench section in a FRESH process: the training benches
     fetch losses to the host, and on tunneled TPU transports the first
@@ -2464,6 +2575,9 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") == "redistribute":
         print(json.dumps(bench_redistribute()))
         return
+    if os.environ.get("BENCH_ONLY") == "autoscale":
+        print(json.dumps(bench_autoscale()))
+        return
 
     device0 = jax.devices()[0]
     on_tpu = device0.platform == "tpu"
@@ -2513,6 +2627,7 @@ def main() -> None:
         ("elastic", bench_elastic, ()),
         ("membership", bench_membership, ()),
         ("redistribute", bench_redistribute, ()),
+        ("autoscale", bench_autoscale, ()),
     ]
     # Retry-until-healthy (VERDICT r5 #1a): a section whose local probe pair
     # straddles a contention dip is re-run (bounded) — the transport

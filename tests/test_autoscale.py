@@ -1,0 +1,525 @@
+"""Policy-driven pool autoscaling (serving/autoscale.py): the acceptance
+drills from docs/serving.md "Autoscaling", all tier-1-fast on CPU.
+
+The headline: under a 4× Poisson flash crowd against a prefill-starved
+disaggregated fleet, a rebalanced fleet flips an idle decode replica into
+the prefill pool through the drain-safe machinery and beats the fixed
+shape on sheds and TTFT p99 — with offered == terminated exact on both
+sides. The guardrails ride along: structural hysteresis holds flips to
+zero under an oscillating signal, the fail-static rung freezes the shape
+(and says why) when the signal source degrades, a chaos kill mid-flip
+aborts cleanly with nothing stranded, and a fleet built WITHOUT a
+rebalancer keeps its metrics schema byte-identical to before the
+subsystem existed. Deadline-aware admission is drilled here too: the
+router sheds a request EARLY when the quoted wait exceeds its remaining
+deadline budget, priced as its own counter.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.models import Llama
+from accelerate_tpu.resilience import FaultPlan
+from accelerate_tpu.serving import (
+    AutoscalePolicy,
+    QueueFull,
+    ReplicaState,
+    RoleRebalancer,
+    ServingEngine,
+    ServingRouter,
+    fleet_signals,
+    make_burst_trace,
+    run_offered_load,
+)
+
+
+@pytest.fixture(scope="module")
+def llama():
+    model = Llama("llama-tiny")
+    return model, model.init(jax.random.key(0))
+
+
+def _prompts(lengths, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (s,)).astype(np.int32) for s in lengths]
+
+
+def _fleet(llama, roles=("prefill", "decode", "decode"), autoscale=None,
+           fault_plan=None, telemetry=None, tracer=None, **engine_kwargs):
+    model, params = llama
+    kwargs = {"num_slots": 2, "max_len": 64, **engine_kwargs}
+    return ServingRouter(
+        engine_factory=lambda: ServingEngine(model, params, **kwargs),
+        num_replicas=len(roles),
+        roles=list(roles),
+        autoscale=autoscale,
+        fault_plan=fault_plan,
+        telemetry=telemetry,
+        tracer=tracer,
+    )
+
+
+def _starved_prefill_reader(router):
+    """Synthetic signals: prefill pool starved, decode pool idle — the
+    unambiguous flip trigger, decoupled from wall-clock load."""
+    return {
+        "fleet_step": router._steps,
+        "pools": {
+            "prefill": {"replicas": 1, "pressure": 5.0},
+            "decode": {"replicas": 2, "pressure": 0.0},
+        },
+    }
+
+
+def _drain(router, results, bound=500):
+    for _ in range(bound):
+        if not router.busy:
+            return True
+        for r in router.step():
+            results[r.request_id] = r
+    return False
+
+
+# -- the acceptance drill -----------------------------------------------------
+
+
+def test_burst_drill_rebalanced_beats_fixed(llama):
+    """The tentpole claim: the SAME Poisson burst trace replays against a
+    fixed [prefill, decode, decode, decode] fleet and one with the
+    rebalancer attached. The rebalanced fleet flips decode replicas into
+    the starved prefill pool mid-burst and must strictly beat the fixed
+    shape on shed count AND TTFT p99 — while both keep offered ==
+    terminated exact and the flip leaves nothing parked behind.
+
+    The load is genuinely PREFILL-bound — chunked prefill makes every
+    56-token prompt a 4-step admission while decode is 2 tokens — and the
+    burst is a flash crowd (the multiplier collapses the middle half of the
+    trace into one clump), so saturation is structural (clump size vs
+    admission capacity), not a race against the machine's step speed."""
+    n = 80
+    prompts = _prompts([56] * n, seed=0)
+    arrivals = make_burst_trace(n, 12.0, burst_multiplier=500.0, burst_fraction=0.5, seed=0)
+
+    def fleet(autoscale=None):
+        return _fleet(
+            llama,
+            roles=("prefill", "decode", "decode", "decode"),
+            autoscale=autoscale,
+            max_queue=2,
+            prefill_chunk=16,
+        )
+
+    fleet().warmup()  # both measured fleets share the model's jit cache
+    fixed = run_offered_load(fleet(), prompts, 2, arrival_times=arrivals)
+
+    # cooldown outlasts the 2x-dwell thrash window: even if the trace's
+    # tail argues for a reversal, it cannot land where it would count as
+    # thrash — the 0 below is structural, not luck
+    rebalancer = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=2, min_dwell_steps=8, cooldown_steps=20)
+    )
+    router = fleet(autoscale=rebalancer)
+    rebalanced = run_offered_load(router, prompts, 2, arrival_times=arrivals)
+
+    # offered == terminated, exact, on BOTH sides of the pair
+    assert fixed["requests_completed"] == n
+    assert rebalanced["requests_completed"] == n
+    # the flip genuinely happened, without thrash, and converged
+    assert rebalancer.flip_count >= 1
+    assert rebalancer.thrash_count == 0
+    assert rebalancer._inflight is None
+    # nothing stranded: every engine's parked ledger ran dry
+    assert all(
+        getattr(r.engine, "parked_count", 0) == 0 for r in router.replicas if r.alive
+    )
+    # the value claim: strictly fewer sheds, strictly lower tail TTFT
+    assert rebalanced["loadgen_sheds"] < fixed["loadgen_sheds"]
+    assert rebalanced["loadgen_ttft_p99_ms"] < fixed["loadgen_ttft_p99_ms"]
+    # a flip reuses the engine's compiled programs: the measured windows
+    # (post-warmup) compiled nothing, flips included
+    assert rebalanced["compile_count"] == 0
+    # gain-schema: the rebalanced fleet's metrics carry the autoscale block
+    assert rebalanced["autoscale_flip_count"] == rebalancer.flip_count
+    assert rebalanced["autoscale_thrash_count"] == 0
+
+
+# -- hysteresis ---------------------------------------------------------------
+
+
+def test_oscillating_signals_hold_shape(llama):
+    """Traffic oscillating around the scale-up threshold while the would-be
+    donor sits mid-deadband must not move a single replica: the deadband
+    needs BOTH a starved pool and an idle donor simultaneously."""
+    calls = {"n": 0}
+
+    def oscillating(router):
+        calls["n"] += 1
+        return {
+            "fleet_step": router._steps,
+            "pools": {
+                # prefill flaps between starved and idle every read...
+                "prefill": {"replicas": 1, "pressure": 5.0 if calls["n"] % 2 else 0.0},
+                # ...but decode never leaves the middle of the deadband
+                "decode": {"replicas": 2, "pressure": 1.0},
+            },
+        }
+
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=2, cooldown_steps=1),
+        signal_reader=oscillating,
+    )
+    router = _fleet(llama, autoscale=reb)
+    for _ in range(30):
+        router.step()
+    assert reb.evaluations > 0
+    assert reb.flip_count == 0
+    assert reb.thrash_count == 0
+    assert reb.fail_static is False
+    assert [r.role for r in router.replicas] == ["prefill", "decode", "decode"]
+
+
+def test_sustained_starvation_flips_once_then_reverse_is_blocked(llama):
+    """Sustained starvation flips exactly one replica (one in-flight
+    transition, then the donor-pool floor holds); an immediate signal
+    reversal is blocked by the direction dwell — no see-saw, thrash 0."""
+    mode = {"reader": _starved_prefill_reader}
+
+    def reader(router):
+        return mode["reader"](router)
+
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=8, cooldown_steps=1),
+        signal_reader=reader,
+    )
+    router = _fleet(llama, autoscale=reb)
+    # a replica's dwell counts from fleet construction, so the first flip
+    # cannot fire before step == min_dwell_steps; step past the gate — the
+    # idle donor then starts AND settles the flip within one step
+    for _ in range(10):
+        router.step()
+    assert reb.flip_count == 1
+    assert sorted(r.role for r in router.replicas) == ["decode", "prefill", "prefill"]
+
+    def reversed_reader(router):
+        return {
+            "fleet_step": router._steps,
+            "pools": {
+                "prefill": {"replicas": 2, "pressure": 0.0},
+                "decode": {"replicas": 1, "pressure": 5.0},
+            },
+        }
+
+    mode["reader"] = reversed_reader
+    for _ in range(5):  # all within min_dwell_steps of the flip
+        router.step()
+    assert reb.flip_count == 1  # the reverse direction never fired
+    assert reb.thrash_count == 0
+
+
+def test_donor_pool_floor_is_checked_against_the_fleet(llama):
+    """A lying signal reader claiming the donor pool has spare replicas must
+    not drain its last member: the never-empty-a-pool guard runs against
+    the fleet's own books, not the reader's claim."""
+
+    def lying(router):
+        return {
+            "fleet_step": router._steps,
+            "pools": {
+                "prefill": {"replicas": 1, "pressure": 5.0},
+                "decode": {"replicas": 99, "pressure": 0.0},  # the lie
+            },
+        }
+
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=1, cooldown_steps=1),
+        signal_reader=lying,
+    )
+    router = _fleet(llama, roles=("prefill", "decode"), autoscale=reb)
+    for _ in range(10):
+        router.step()
+    assert reb.flip_count == 0  # decode pool's only member stayed put
+    assert [r.role for r in router.replicas] == ["prefill", "decode"]
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="deadband inverted"):
+        AutoscalePolicy(scale_up_pressure=1.0, scale_down_pressure=1.5)
+    with pytest.raises(ValueError, match=">= 1"):
+        AutoscalePolicy(cadence_steps=0)
+
+
+# -- fail-static --------------------------------------------------------------
+
+
+def test_chaos_signal_outage_lands_in_fail_static(llama, tmp_path):
+    """The signal-outage chaos leg: the rebalancer freezes the fleet's
+    shape, records ONE {"kind": "autoscale"} fail_static record naming the
+    reason, and the fleet keeps serving its current shape throughout."""
+    from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+    hub = Telemetry(config=TelemetryConfig(dir=str(tmp_path)))
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=1, cooldown_steps=1),
+        signal_reader=_starved_prefill_reader,
+    )
+    router = _fleet(
+        llama, autoscale=reb,
+        fault_plan=FaultPlan(autoscale_outage_step=0), telemetry=hub,
+    )
+    rids = [router.submit(p, max_new_tokens=3) for p in _prompts([5, 7], seed=1)]
+    results = {}
+    assert _drain(router, results)
+    assert sorted(results) == sorted(rids)  # frozen shape still serves
+    assert reb.fail_static is True
+    assert reb.fail_static_count == 1  # one episode, not one per step
+    assert "chaos" in reb.fail_static_reason
+    assert reb.flip_count == 0  # starvation signals ignored while frozen
+    m = router.metrics()
+    assert m["autoscale_fail_static"] is True
+    assert m["autoscale_fail_static_reason"] == reb.fail_static_reason
+    hub.finish(flush=False)
+    records = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    frozen = [r for r in records if r.get("kind") == "autoscale"
+              and r.get("event") == "fail_static"]
+    assert len(frozen) == 1
+    assert "chaos" in frozen[0]["reason"]
+
+
+def test_fail_static_clears_when_signals_recover(llama, tmp_path):
+    """A bounded outage: the rebalancer freezes for its duration, records
+    the clearing edge when reads recover, and resumes flipping."""
+    from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+    hub = Telemetry(config=TelemetryConfig(dir=str(tmp_path)))
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=1, cooldown_steps=1),
+        signal_reader=_starved_prefill_reader,
+    )
+    router = _fleet(
+        llama, autoscale=reb,
+        fault_plan=FaultPlan(autoscale_outage_step=0, autoscale_outage_duration=3),
+        telemetry=hub,
+    )
+    for _ in range(8):
+        router.step()
+    assert reb.fail_static is False
+    assert reb.fail_static_count == 1
+    assert reb.flip_count >= 1  # decisions resumed after the outage window
+    hub.finish(flush=False)
+    records = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    events = [r["event"] for r in records if r.get("kind") == "autoscale"]
+    assert events.index("fail_static") < events.index("fail_static_cleared")
+    cleared = next(r for r in records if r.get("event") == "fail_static_cleared")
+    assert "chaos" in cleared["was"]
+
+
+def test_raising_signal_reader_freezes_not_crashes(llama):
+    """A reader that raises is a degraded signal source, not a fleet
+    outage: step() keeps working, the shape freezes, the reason names the
+    exception."""
+
+    def broken(router):
+        raise RuntimeError("telemetry store unreachable")
+
+    reb = RoleRebalancer(signal_reader=broken)
+    router = _fleet(llama, autoscale=reb)
+    rids = [router.submit(p, max_new_tokens=3) for p in _prompts([5], seed=2)]
+    results = {}
+    assert _drain(router, results)
+    assert sorted(results) == sorted(rids)
+    assert reb.fail_static is True
+    assert "RuntimeError" in reb.fail_static_reason
+
+
+def test_stale_rollup_freezes(llama):
+    """A rollup whose fleet_step stamp lags beyond stale_after_steps is not
+    trusted: frozen, with the staleness in the reason."""
+
+    def stale(router):
+        return {"fleet_step": 0, "pools": _starved_prefill_reader(router)["pools"]}
+
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(
+            cadence_steps=1, min_dwell_steps=1, cooldown_steps=1, stale_after_steps=2
+        ),
+        signal_reader=stale,
+    )
+    router = _fleet(llama, autoscale=reb)
+    for _ in range(6):
+        router.step()
+    assert reb.fail_static is True
+    assert "stale" in reb.fail_static_reason
+    assert reb.flip_count <= 1  # only while the stamp was still fresh
+
+
+# -- chaos: mid-flip kill -----------------------------------------------------
+
+
+def test_mid_flip_kill_aborts_and_converges(llama):
+    """ACCELERATE_CHAOS_REBALANCE_FAIL_AT kills the donor mid-flip: the
+    flip aborts (no livelock, in-flight slot released), the router's
+    ordinary death machinery re-homes everything, no parked KV is
+    stranded, and offered == terminated holds exactly."""
+    reb = RoleRebalancer(
+        policy=AutoscalePolicy(cadence_steps=1, min_dwell_steps=1, cooldown_steps=1),
+        signal_reader=_starved_prefill_reader,
+    )
+    router = _fleet(
+        llama, autoscale=reb, fault_plan=FaultPlan(rebalance_fail_at=(0,)),
+    )
+    rids = [router.submit(p, max_new_tokens=4) for p in _prompts([6, 9, 5, 7], seed=3)]
+    results = {}
+    assert _drain(router, results), "mid-flip kill livelocked the fleet"
+    assert sorted(results) == sorted(rids)  # terminated exactly once each
+    assert reb.aborted_flips == 1
+    assert reb._inflight is None
+    dead = [r for r in router.replicas if not r.alive]
+    assert len(dead) == 1 and "mid role-flip" in dead[0].death_reason
+    # the surviving decode replica is the pool's last member: the floor
+    # guard holds it, so the fleet converges instead of flip-looping
+    assert reb.flip_count == 0
+    assert all(
+        getattr(r.engine, "parked_count", 0) == 0 for r in router.replicas if r.alive
+    )
+    assert [e["fault"] for e in router.chaos.events if e["fault"] == "rebalance_fail"]
+
+
+def test_autoscale_chaos_env_vars(monkeypatch):
+    """The new legs arm from the environment like every other chaos leg."""
+    monkeypatch.setenv("ACCELERATE_CHAOS_REBALANCE_FAIL_AT", "0,2")
+    monkeypatch.setenv("ACCELERATE_CHAOS_AUTOSCALE_OUTAGE_STEP", "5")
+    monkeypatch.setenv("ACCELERATE_CHAOS_AUTOSCALE_OUTAGE_DURATION", "3")
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.active
+    assert plan.rebalance_fail_at == (0, 2)
+    assert plan.rebalance_fail(0) is True
+    assert plan.rebalance_fail(1) is False
+    assert plan.autoscale_outage(4) is False
+    assert plan.autoscale_outage(5) is True
+    assert plan.autoscale_outage(7) is True
+    assert plan.autoscale_outage(8) is False  # duration elapsed
+    faults = [e["fault"] for e in plan.events]
+    assert "rebalance_fail" in faults and "autoscale_outage" in faults
+
+
+# -- deadline-aware admission -------------------------------------------------
+
+
+def test_deadline_admission_sheds_early(llama, tmp_path):
+    """A request whose quoted queue wait exceeds its remaining deadline
+    budget sheds at SUBMIT — before burning a prefill — and is priced as
+    its own counter with its own telemetry reason."""
+    from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+    hub = Telemetry(config=TelemetryConfig(dir=str(tmp_path)))
+    router = _fleet(llama, roles=("mixed",), max_queue=4, telemetry=hub)
+    prompts = _prompts([6, 6, 6, 6], seed=4)
+    # fill both slots and put one in the queue: the gate only fires where
+    # the request would actually WAIT
+    router.submit(prompts[0], max_new_tokens=24)
+    router.submit(prompts[1], max_new_tokens=24)
+    router.step()
+    router.submit(prompts[2], max_new_tokens=24)
+    assert router.replicas[0].engine.scheduler.waiting == 1
+    with pytest.raises(QueueFull, match="deadline-aware admission"):
+        router.submit(prompts[3], max_new_tokens=24, deadline_s=1e-6)
+    assert router.router_deadline_sheds == 1
+    assert router.metrics()["router_deadline_sheds"] == 1
+    # the control: the SAME request without a deadline is admitted
+    rid = router.submit(prompts[3], max_new_tokens=24)
+    assert rid in router._inflight
+    hub.finish(flush=False)
+    records = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    shed = [r for r in records if r.get("event") == "shed"]
+    assert len(shed) == 1 and shed[0]["reason"] == "deadline"
+    assert shed[0]["retry_after_s"] > 0
+    assert shed[0]["deadline_s"] == 1e-6
+
+
+def test_deadline_gate_skips_idle_fleet(llama):
+    """An idle replica serves immediately whatever the hint formula says:
+    the gate must not early-shed against an empty queue (that way lies a
+    shed-forever livelock — the engine's own deadline expiry is the honest
+    terminal state)."""
+    router = _fleet(llama, roles=("mixed",))
+    rid = router.submit(_prompts([6], seed=5)[0], max_new_tokens=8, deadline_s=1e-6)
+    assert router.router_deadline_sheds == 0
+    results = {}
+    assert _drain(router, results)
+    assert results[rid].finish_reason == "expired"
+
+
+# -- shed-hint pricing --------------------------------------------------------
+
+
+def test_no_placeable_hint_prices_draining_at_drain_eta(llama):
+    """The shed-quote regression: with every replica DRAINING mid-work, the
+    retry_after_s hint must quote the drain ETA (active slots running to
+    completion), not the optimistic one-queue-position hint of a replica
+    that admits nothing."""
+    router = _fleet(llama, roles=("mixed", "mixed"))
+    prompts = _prompts([6, 6], seed=6)
+    router.submit(prompts[0], max_new_tokens=24)
+    router.submit(prompts[1], max_new_tokens=24)
+    router.step()  # both replicas have active slots and step stats
+    router.drain_replica(0)
+    router.drain_replica(1)
+    with pytest.raises(QueueFull) as exc_info:
+        router.submit(_prompts([5], seed=7)[0], max_new_tokens=4)
+    expected = min(r.engine.drain_eta_hint() for r in router.replicas)
+    assert exc_info.value.retry_after_s == pytest.approx(expected)
+    assert exc_info.value.retry_after_s > 0
+
+
+# -- schema parity ------------------------------------------------------------
+
+
+def test_autoscale_none_keeps_schema_byte_identical(llama, tmp_path):
+    """A fleet built without a rebalancer (the default) must emit NO
+    autoscale_* metrics keys and NO {"kind": "autoscale"} records — the
+    subsystem is gain-only, invisible until attached."""
+    from accelerate_tpu.telemetry import Telemetry, TelemetryConfig
+
+    hub = Telemetry(config=TelemetryConfig(dir=str(tmp_path)))
+    router = _fleet(llama, telemetry=hub)
+    rids = [router.submit(p, max_new_tokens=3) for p in _prompts([5, 8], seed=8)]
+    results = {}
+    assert _drain(router, results)
+    assert sorted(results) == sorted(rids)
+    m = router.metrics()
+    assert not any(k.startswith("autoscale_") for k in m)
+    # deadline pricing is always-on router admission, not autoscale gain
+    assert m["router_deadline_sheds"] == 0
+    router.flush_telemetry()
+    hub.finish(flush=False)
+    records = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    assert not any(r.get("kind") == "autoscale" for r in records)
+
+
+# -- signals ------------------------------------------------------------------
+
+
+def test_fleet_signals_rollup_shape(llama):
+    """The default signal read: per-pool pressure off the fleet's own
+    books, stamped with the fleet step, pending demand attributed by
+    phase."""
+    router = _fleet(llama)
+    rid = router.submit(_prompts([6], seed=9)[0], max_new_tokens=4)
+    router.step()  # prefill + park: the request is now decode-pool demand
+    signals = fleet_signals(router)
+    assert signals["fleet_step"] == router._steps
+    assert set(signals["pools"]) == {"prefill", "decode"}
+    for pool in signals["pools"].values():
+        assert pool["replicas"] >= 1
+        assert pool["pressure"] >= 0.0
+        assert 0.0 <= pool["slot_occupancy"] <= 1.0
+    # the parked request awaiting handoff is DECODE demand, not prefill
+    assert signals["pools"]["decode"]["pending"] >= 1
+    assert signals["pools"]["prefill"]["pending"] == 0
+    results = {}
+    assert _drain(router, results)
+    assert rid in results

@@ -1,0 +1,141 @@
+"""Offered-load generation (serving/loadgen.py): arrival-trace helpers and
+the loadgen's own client-observed ledger.
+
+The trace makers are pinned on their statistical claims — burst amplitude
+(the clump's inter-arrival gaps shrink by the multiplier), diurnal period
+(arrival density follows the sinusoid's peak and trough halves), strict
+monotonicity — and ``run_offered_load``'s ``arrival_times=`` escape hatch
+is drilled end to end against a real engine: offered == completed, the
+TTFT/latency percentiles come from the results the caller actually saw,
+and the finish-reason histogram accounts for every completion.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.models import Llama
+from accelerate_tpu.serving import (
+    ServingEngine,
+    make_burst_trace,
+    make_diurnal_trace,
+    run_offered_load,
+)
+
+
+@pytest.fixture(scope="module")
+def llama():
+    model = Llama("llama-tiny")
+    return model, model.init(jax.random.key(0))
+
+
+# -- trace shape --------------------------------------------------------------
+
+
+def test_burst_trace_amplitude():
+    """The middle burst_fraction of arrivals runs burst_multiplier× faster:
+    the mean inter-arrival gap inside the clump is ~multiplier× smaller
+    than outside (Poisson, so statistically — large n, loose tolerance)."""
+    n, mult = 4000, 4.0
+    times = make_burst_trace(n, base_rps=10.0, burst_multiplier=mult,
+                             burst_fraction=0.5, seed=0)
+    gaps = np.diff(np.asarray(times))
+    lo, hi = n // 4, n - n // 4
+    outside = np.concatenate([gaps[: lo - 1], gaps[hi:]])
+    inside = gaps[lo:hi]
+    ratio = outside.mean() / inside.mean()
+    assert ratio == pytest.approx(mult, rel=0.25)
+
+
+def test_burst_trace_monotone_and_positive():
+    times = make_burst_trace(500, base_rps=50.0, seed=3)
+    assert times[0] > 0.0
+    assert all(b > a for a, b in zip(times, times[1:]))
+
+
+def test_diurnal_trace_period():
+    """Arrivals pile into the sinusoid's peak half-period and thin out in
+    the trough half: folding every arrival by the period, the peak half
+    must hold clearly more than the trough half."""
+    period = 8.0
+    times = make_diurnal_trace(4000, base_rps=50.0, period_s=period,
+                               amplitude=0.8, seed=1)
+    phase = np.asarray(times) % period
+    peak = int((phase < period / 2).sum())  # sin > 0: rate above base
+    trough = len(times) - peak
+    assert peak > 2 * trough
+
+
+def test_diurnal_trace_monotone():
+    times = make_diurnal_trace(500, base_rps=50.0, amplitude=0.9, seed=2)
+    assert all(b > a for a, b in zip(times, times[1:]))
+
+
+def test_trace_validation():
+    with pytest.raises(ValueError, match="n must be positive"):
+        make_burst_trace(0, 10.0)
+    with pytest.raises(ValueError, match="base_rps"):
+        make_burst_trace(10, 0.0)
+    with pytest.raises(ValueError, match="burst_multiplier"):
+        make_burst_trace(10, 10.0, burst_multiplier=0.5)
+    with pytest.raises(ValueError, match="burst_fraction"):
+        make_burst_trace(10, 10.0, burst_fraction=1.5)
+    with pytest.raises(ValueError, match="amplitude"):
+        make_diurnal_trace(10, 10.0, amplitude=1.0)
+    with pytest.raises(ValueError, match="period_s"):
+        make_diurnal_trace(10, 10.0, period_s=0.0)
+
+
+def test_traces_are_deterministic_per_seed():
+    assert make_burst_trace(50, 10.0, seed=7) == make_burst_trace(50, 10.0, seed=7)
+    assert make_burst_trace(50, 10.0, seed=7) != make_burst_trace(50, 10.0, seed=8)
+    assert make_diurnal_trace(50, 10.0, seed=7) == make_diurnal_trace(50, 10.0, seed=7)
+
+
+# -- run_offered_load ledger --------------------------------------------------
+
+
+def test_arrival_times_validation(llama):
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=2, max_len=64)
+    prompts = [np.arange(4, dtype=np.int32)] * 3
+    with pytest.raises(ValueError, match="one arrival per prompt"):
+        run_offered_load(engine, prompts, 4, arrival_times=[0.0, 0.1])
+    with pytest.raises(ValueError, match="non-decreasing"):
+        run_offered_load(engine, prompts, 4, arrival_times=[0.0, 0.2, 0.1])
+
+
+def test_offered_load_ledger_with_arrival_times(llama):
+    """The escape hatch end to end: an explicit arrival trace replays
+    against a real engine; every offered request completes, the ledger's
+    percentiles exist and order sanely, and the finish-reason histogram
+    accounts for every completion."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=2, max_len=64)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 1024, (int(s),)).astype(np.int32)
+               for s in rng.integers(4, 12, 8)]
+    arrivals = make_burst_trace(len(prompts), base_rps=200.0, seed=0)
+    point = run_offered_load(engine, prompts, 4, arrival_times=arrivals)
+    assert point["offered_requests"] == len(prompts)
+    assert point["requests_completed"] == len(prompts)
+    assert point["offered_rps"] is None  # the trace, not a uniform rate
+    assert point["loadgen_ttft_p50_ms"] > 0
+    assert point["loadgen_ttft_p99_ms"] >= point["loadgen_ttft_p50_ms"]
+    assert point["loadgen_latency_p50_ms"] >= point["loadgen_ttft_p50_ms"]
+    assert point["loadgen_latency_p99_ms"] >= point["loadgen_latency_p50_ms"]
+    assert sum(point["loadgen_finish_reasons"].values()) == len(prompts)
+    assert point["loadgen_finish_reasons"] == {"length": len(prompts)}
+
+
+def test_offered_load_uniform_rate_keeps_ledger(llama):
+    """The pre-existing uniform-rate path reports the same ledger keys —
+    one output schema whatever drove the arrivals."""
+    model, params = llama
+    engine = ServingEngine(model, params, num_slots=2, max_len=64)
+    prompts = [np.arange(6, dtype=np.int32)] * 3
+    point = run_offered_load(engine, prompts, 3)
+    assert point["requests_completed"] == 3
+    assert point["loadgen_ttft_p50_ms"] > 0
+    assert point["loadgen_finish_reasons"] == {"length": 3}
