@@ -1,6 +1,6 @@
 """Static analysis for compiled step and decode programs.
 
-Six passes over the layers of the stack, one report shape:
+Seven passes over the layers of the stack, one report shape:
 
 - :mod:`.program` — jaxpr/HLO audit of a ``jax.stages.Lowered``/``Compiled``
   program: donation aliasing, fp64 leaks, baked-in constants, the collective
@@ -21,12 +21,30 @@ Six passes over the layers of the stack, one report shape:
   signature diffs), jit-cache misses.
 - :mod:`.lint` — AST lint of user step functions (and this repo's own code)
   for trace-time hazards: branching on traced values, wall clocks, host RNG,
-  host materialization, captured-state mutation.
+  host materialization, captured-state mutation — plus the module-wide
+  concurrency rule family (bare acquires, blocking-under-lock, unguarded
+  thread-shared state, numpy views into async dispatch, raw locks).
+- :mod:`.concurrency` — runtime lock-order race detector: every subsystem
+  lock is a :func:`named_lock`, the :class:`LockRegistry` records per-thread
+  held-before edges, and :func:`record` patches the blocking boundaries
+  (``time.sleep``, ``os.fsync``, ``block_until_ready``, store I/O) so a lock
+  held across one becomes a ``LOCK_BLOCKING_HOLD`` finding and an
+  acquisition-order cycle becomes ``CONCURRENCY_CYCLE``. Gated by
+  ``tests/contracts/concurrency.json``.
 
 CLI: ``accelerate-tpu analyze`` (commands/analyze.py). Findings catalog:
 docs/analysis.md.
 """
 
+from .concurrency import (
+    ConcurrencyContract,
+    LockRegistry,
+    gate_concurrency,
+    named_lock,
+    note_blocking,
+    record,
+    registry,
+)
 from .contracts import (
     ProgramContract,
     default_contracts_dir,
@@ -56,8 +74,10 @@ __all__ = [
     "INFO",
     "WARNING",
     "AnalysisReport",
+    "ConcurrencyContract",
     "Finding",
     "HazardSanitizer",
+    "LockRegistry",
     "ProgramContract",
     "audit_lowered",
     "collective_inventory",
@@ -70,12 +90,17 @@ __all__ = [
     "dtype_audit",
     "explain_recompile",
     "flatten_args_info",
+    "gate_concurrency",
     "gate_reports",
     "lint_file",
     "lint_paths",
     "lint_source",
     "memory_audit",
     "memory_summary",
+    "named_lock",
+    "note_blocking",
+    "record",
+    "registry",
     "replication_audit",
     "schedule_audit",
     "signature_of",

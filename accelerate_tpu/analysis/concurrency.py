@@ -1,0 +1,518 @@
+"""Runtime concurrency sanitizer: lock-order and blocking-hold detection.
+
+The host orchestration layer around the compiled programs — membership
+heartbeats, the collective-hang watchdog, the telemetry hub's jsonl sink,
+the serving fleet's step watchdogs, the redistribute sequencer — is real
+multithreaded code, and its failure modes (lock-order inversions, blocking
+I/O under a lock every other thread needs) are invisible to both the
+program audit and the AST lint. This module makes them *named findings*:
+
+- :func:`named_lock` wraps ``threading.Lock`` with a registry name. Every
+  lock in this codebase is constructed through it, so the registry's
+  inventory IS the codebase's lock surface — a new lock shows up in the
+  ``concurrency`` contract diff (and a new *raw* ``threading.Lock()`` is a
+  ``LOCK_UNREGISTERED`` lint finding), never silently.
+- The process-global :class:`LockRegistry` keeps a per-thread held-lock
+  stack (always on — one list append per acquire) and, while a
+  :func:`record` window is open, folds every nested acquisition into an
+  acquisition-order graph. A cycle in that graph (``A → B`` in one thread,
+  ``B → A`` in another) is a potential deadlock: ``CONCURRENCY_CYCLE``.
+- :func:`record` additionally interposes the blocking boundaries —
+  ``time.sleep``, ``os.fsync``, ``jax.block_until_ready``,
+  ``jax.device_get``, and the chaos layer's store-I/O probe — and any of
+  them reached while this thread holds a named lock is a
+  ``LOCK_BLOCKING_HOLD`` finding naming the lock and the boundary (the
+  PR 14 bug class, mechanized).
+- :class:`ConcurrencyContract` pins the clean state (zero cycles, zero
+  blocking holds, the exact lock-name inventory) as
+  ``tests/contracts/concurrency.json``; ``analyze --self-check`` runs the
+  2-replica traced fleet + an elastic coordinator under the recorder and
+  gates that contract the same way program contracts gate collective drift.
+
+The recorder's cost is one flag check per acquire when off, and a small
+dict update under the registry's bookkeeping mutex when on — cheap enough
+to ride along the existing chaos drills. The report serializes as a
+``{"kind": "concurrency"}`` telemetry record via
+``telemetry.write_record("concurrency", report.to_dict())``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .findings import AnalysisReport, Finding
+
+CONTRACT_FILENAME = "concurrency.json"
+
+
+def _call_site() -> str:
+    """First stack frame outside this module (and jax/stdlib wrappers) —
+    where the blocking call was *requested*."""
+    here = __file__
+    for frame, lineno in traceback.walk_stack(None):
+        filename = frame.f_code.co_filename
+        if filename == here or "/jax/" in filename or "/jaxlib/" in filename:
+            continue
+        return f"{filename}:{lineno} ({frame.f_code.co_name})"
+    return "<unknown>"
+
+
+def _find_cycles(edges: set) -> list[list[str]]:
+    """Enumerate the simple cycles of a (tiny) directed lock-order graph.
+    Deduped up to rotation by anchoring each cycle at its lexicographically
+    smallest node."""
+    adjacency: dict[str, set[str]] = {}
+    for a, b in edges:
+        adjacency.setdefault(a, set()).add(b)
+    nodes = sorted(set(adjacency) | {b for targets in adjacency.values() for b in targets})
+    order = {name: i for i, name in enumerate(nodes)}
+    cycles: list[list[str]] = []
+
+    def dfs(start: str, node: str, path: list[str], visited: set[str]) -> None:
+        for nxt in sorted(adjacency.get(node, ())):
+            if order[nxt] < order[start]:
+                continue  # that cycle is (or will be) found anchored at nxt
+            if nxt == start:
+                cycles.append(list(path))
+            elif nxt not in visited:
+                visited.add(nxt)
+                path.append(nxt)
+                dfs(start, nxt, path, visited)
+                path.pop()
+                visited.remove(nxt)
+
+    for start in nodes:
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+class LockRegistry:
+    """Process-global bookkeeping for every :func:`named_lock`.
+
+    Always on: per-thread held stacks (a list append/pop per acquire —
+    nothing shared, nothing contended). Recording on: held-before edges and
+    blocking-hold attribution, guarded by a plain bookkeeping mutex that is
+    never held across any user code."""
+
+    def __init__(self):
+        # the registry's own bookkeeping mutex must be a RAW lock: wrapping
+        # it in named_lock would recurse into this registry on every acquire
+        self._meta = threading.Lock()  # accel-lint: disable=LOCK_UNREGISTERED
+        self._tls = threading.local()
+        self._names: dict[str, int] = {}  # name -> instances constructed
+        self._edges: dict[tuple[str, str], int] = {}  # (held, acquired) -> count
+        # (lock name, boundary kind) -> {"count", "site"}
+        self._blocking: dict[tuple[str, str], dict] = {}
+        self._max_hold: dict[str, float] = {}
+        self._acquisitions = 0
+        self._recording = False
+
+    # -- registration / held-stack maintenance (always on) -----------------
+
+    def register(self, name: str) -> None:
+        with self._meta:
+            self._names[name] = self._names.get(name, 0) + 1
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def on_acquired(self, name: str) -> None:
+        stack = self._stack()
+        if self._recording:
+            with self._meta:
+                self._acquisitions += 1
+                for held_name, _ in stack:
+                    if held_name != name:  # same-name nesting is two instances
+                        key = (held_name, name)
+                        self._edges[key] = self._edges.get(key, 0) + 1
+        stack.append((name, time.perf_counter()))
+
+    def on_released(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                _, acquired_at = stack.pop(i)
+                break
+        else:
+            return
+        if self._recording:
+            held_for = time.perf_counter() - acquired_at
+            with self._meta:
+                if held_for > self._max_hold.get(name, 0.0):
+                    self._max_hold[name] = held_for
+
+    def note_blocking(self, kind: str, site: Optional[str] = None) -> None:
+        """A blocking boundary was reached on this thread. Attributed to
+        every lock the thread currently holds (an outer lock held across a
+        blocking inner call is just as stalled)."""
+        if not self._recording:
+            return
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return
+        if site is None:
+            site = _call_site()
+        with self._meta:
+            for held_name, _ in stack:
+                key = (held_name, kind)
+                entry = self._blocking.get(key)
+                if entry is None:
+                    self._blocking[key] = {"count": 1, "site": site}
+                else:
+                    entry["count"] += 1
+
+    # -- recording window ---------------------------------------------------
+
+    def start(self) -> None:
+        self._recording = True
+
+    def stop(self) -> None:
+        self._recording = False
+
+    def reset_observations(self) -> None:
+        """Clear edges/blocking/hold observations (NOT the name inventory —
+        locks registered at construction stay registered for the process)."""
+        with self._meta:
+            self._edges.clear()
+            self._blocking.clear()
+            self._max_hold.clear()
+            self._acquisitions = 0
+
+    def forget(self, *names: str) -> None:
+        """Drop names AND their observations from the inventory. For test
+        fixtures: a seeded ``test.A``/``test.B`` inversion must not leak
+        into the exact-lock-inventory contract a later drill in the same
+        process records against."""
+        gone = set(names)
+        with self._meta:
+            for name in gone:
+                self._names.pop(name, None)
+                self._max_hold.pop(name, None)
+            self._edges = {
+                key: count for key, count in self._edges.items()
+                if key[0] not in gone and key[1] not in gone
+            }
+            self._blocking = {
+                key: entry for key, entry in self._blocking.items()
+                if key[0] not in gone
+            }
+
+    # -- readout -------------------------------------------------------------
+
+    def lock_names(self) -> list[str]:
+        with self._meta:
+            return sorted(self._names)
+
+    def edges(self) -> list[tuple[str, str]]:
+        with self._meta:
+            return sorted(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        with self._meta:
+            edge_set = set(self._edges)
+        return _find_cycles(edge_set)
+
+    def blocking_holds(self) -> list[dict]:
+        with self._meta:
+            return [
+                {"lock": lock, "kind": kind, **entry}
+                for (lock, kind), entry in sorted(self._blocking.items())
+            ]
+
+    def report(self) -> AnalysisReport:
+        """The observations as findings + diffable inventory. ``meta.kind``
+        marks it for the ``{"kind": "concurrency"}`` telemetry record."""
+        with self._meta:
+            names = dict(self._names)
+            edges = dict(self._edges)
+            max_hold = dict(self._max_hold)
+            acquisitions = self._acquisitions
+        blocking = self.blocking_holds()
+        cycles = _find_cycles(set(edges))
+        report = AnalysisReport(meta={"label": "concurrency", "kind": "concurrency"})
+        for cycle in cycles:
+            loop = " -> ".join([*cycle, cycle[0]])
+            report.add(
+                Finding(
+                    "CONCURRENCY_CYCLE",
+                    f"lock acquisition-order cycle {loop}: these locks were "
+                    "taken in opposite orders on different code paths — two "
+                    "threads interleaving there deadlock",
+                    path=f"locks:{loop}",
+                    data={"cycle": cycle},
+                )
+            )
+        for entry in blocking:
+            report.add(
+                Finding(
+                    "LOCK_BLOCKING_HOLD",
+                    f"lock '{entry['lock']}' held across blocking boundary "
+                    f"`{entry['kind']}` ({entry['count']}x)",
+                    path=entry.get("site"),
+                    data={k: v for k, v in entry.items() if k != "site"},
+                )
+            )
+        report.inventory = {
+            "locks": sorted(names),
+            "lock_instances": names,
+            "acquisitions": acquisitions,
+            "edges": [[a, b, count] for (a, b), count in sorted(edges.items())],
+            "cycles": cycles,
+            "blocking_holds": blocking,
+            "max_hold_seconds": {
+                name: round(seconds, 6) for name, seconds in sorted(max_hold.items())
+            },
+        }
+        return report
+
+
+_REGISTRY = LockRegistry()
+
+
+def registry() -> LockRegistry:
+    return _REGISTRY
+
+
+class _NamedLock:
+    """A ``threading.Lock`` with a registry identity. Same surface
+    (``acquire``/``release``/``locked``/context manager); every transition
+    feeds the registry's held-stack so lock-order edges and blocking-hold
+    attribution see it. Several instances may share one name (e.g. every
+    ``CompileTracker``'s event lock is ``compile_tracker.events``) — the
+    *name* is the unit of the order graph, which is exactly the granularity
+    a reviewer reasons at."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner: Optional[Any] = None):
+        self.name = name
+        if inner is None:
+            inner = threading.Lock()  # accel-lint: disable=LOCK_UNREGISTERED
+        self._inner = inner
+        _REGISTRY.register(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        acquired = self._inner.acquire(blocking, timeout)
+        if acquired:
+            _REGISTRY.on_acquired(self.name)
+        return acquired
+
+    def release(self) -> None:
+        _REGISTRY.on_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_NamedLock":
+        self.acquire()  # accel-lint: disable=LOCK_BARE_ACQUIRE
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        state = "locked" if self.locked() else "unlocked"
+        return f"<named_lock {self.name!r} {state}>"
+
+
+def named_lock(name: str, inner: Optional[Any] = None) -> _NamedLock:
+    """Construct (or wrap) a lock under a registry name. Adopted at every
+    lock construction site in this codebase; the name becomes part of the
+    ``concurrency`` contract's exact inventory."""
+    return _NamedLock(name, inner)
+
+
+def note_blocking(kind: str, site: Optional[str] = None) -> None:
+    """Module-level hook for blocking boundaries the recorder cannot patch
+    (the chaos layer's ``probe_io`` calls this for store I/O)."""
+    _REGISTRY.note_blocking(kind, site)
+
+
+def reset_observations() -> None:
+    _REGISTRY.reset_observations()
+
+
+@contextmanager
+def record():
+    """Arm the recorder: acquisition-order edges accumulate, and the
+    blocking boundaries — ``time.sleep``, ``os.fsync``,
+    ``jax.block_until_ready``, ``jax.device_get`` — are interposed so a
+    lock held across any of them becomes a ``LOCK_BLOCKING_HOLD``. Not
+    reentrant (one recording window at a time); patches restore LIFO on
+    exit. Yields the registry; read ``registry().report()`` after."""
+    _REGISTRY.start()
+    patched: list[tuple[Any, str, Any]] = []
+
+    def interpose(owner: Any, attr: str, kind: str) -> None:
+        original = getattr(owner, attr, None)
+        if original is None:
+            return
+
+        def wrapper(*args, **kwargs):
+            _REGISTRY.note_blocking(kind)
+            return original(*args, **kwargs)
+
+        wrapper.__name__ = getattr(original, "__name__", attr)
+        try:
+            setattr(owner, attr, wrapper)
+        except (TypeError, AttributeError):
+            return
+        patched.append((owner, attr, original))
+
+    interpose(time, "sleep", "time.sleep")
+    interpose(os, "fsync", "os.fsync")
+    try:
+        import jax
+    except ImportError:  # static-analysis-only environments
+        jax = None
+    if jax is not None:
+        interpose(jax, "block_until_ready", "block_until_ready")
+        interpose(jax, "device_get", "device_get")
+    try:
+        yield _REGISTRY
+    finally:
+        for owner, attr, original in reversed(patched):
+            setattr(owner, attr, original)
+        _REGISTRY.stop()
+
+
+# -- the concurrency contract -------------------------------------------------
+
+
+@dataclass
+class ConcurrencyContract:
+    """Checked-in expectations for the recorded drill: zero cycles, zero
+    blocking holds, and the EXACT lock-name inventory — a lock added (or
+    renamed, or removed) anywhere in the codebase moves this file in a
+    reviewed diff. Counts are exact; there is nothing to tolerance here."""
+
+    locks: list[str] = field(default_factory=list)
+    cycles: int = 0
+    blocking_holds: int = 0
+    version: int = 1
+
+    @classmethod
+    def from_report(cls, report: AnalysisReport) -> "ConcurrencyContract":
+        inventory = report.inventory
+        return cls(
+            locks=sorted(inventory.get("locks", [])),
+            cycles=len(inventory.get("cycles", [])),
+            blocking_holds=len(inventory.get("blocking_holds", [])),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ConcurrencyContract":
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        expectations = payload.get("expectations", {})
+        return cls(
+            locks=[str(name) for name in expectations.get("locks", [])],
+            cycles=int(expectations.get("cycles", 0)),
+            blocking_holds=int(expectations.get("blocking_holds", 0)),
+            version=int(payload.get("version", 1)),
+        )
+
+    def to_json(self) -> str:
+        payload = {
+            "program": "concurrency",
+            "version": self.version,
+            "expectations": {
+                "cycles": self.cycles,
+                "blocking_holds": self.blocking_holds,
+                "locks": sorted(self.locks),
+            },
+        }
+        return json.dumps(payload, indent=2, sort_keys=True) + "\n"
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+
+    def check(self, report: AnalysisReport) -> list[Finding]:
+        findings: list[Finding] = []
+        inventory = report.inventory
+
+        def drift(fieldname: str, expected, actual, detail: str = "") -> None:
+            findings.append(
+                Finding(
+                    "CONTRACT_DRIFT",
+                    f"concurrency: {fieldname} drifted from its contract: "
+                    f"expected {expected}, got {actual}"
+                    + (f" ({detail})" if detail else ""),
+                    path=f"concurrency:{fieldname}",
+                    data={
+                        "program": "concurrency",
+                        "field": fieldname,
+                        "expected": expected,
+                        "actual": actual,
+                    },
+                )
+            )
+
+        cycles = inventory.get("cycles", [])
+        if len(cycles) != self.cycles:
+            drift(
+                "cycles", self.cycles, len(cycles),
+                "; ".join(" -> ".join(c) for c in cycles[:3]),
+            )
+        blocking = inventory.get("blocking_holds", [])
+        if len(blocking) != self.blocking_holds:
+            drift(
+                "blocking_holds", self.blocking_holds, len(blocking),
+                "; ".join(f"{b['lock']}@{b['kind']}" for b in blocking[:3]),
+            )
+        actual_locks = sorted(inventory.get("locks", []))
+        expected_locks = sorted(self.locks)
+        if actual_locks != expected_locks:
+            added = sorted(set(actual_locks) - set(expected_locks))
+            removed = sorted(set(expected_locks) - set(actual_locks))
+            parts = []
+            if added:
+                parts.append(f"new locks {added}")
+            if removed:
+                parts.append(f"missing locks {removed}")
+            drift("locks", expected_locks, actual_locks, "; ".join(parts))
+        return findings
+
+
+def gate_concurrency(
+    report: AnalysisReport, contracts_dir: str, *, update: bool = False
+) -> list[Finding]:
+    """Check (or refresh) the recorded drill report against
+    ``<contracts_dir>/concurrency.json``. Mirrors the program-contract gate:
+    churn-free updates, ``CONTRACT_DRIFT`` errors on any mismatch, a
+    ``CONTRACT_MISSING`` warning when the file was never committed."""
+    path = os.path.join(contracts_dir, CONTRACT_FILENAME)
+    if update:
+        if os.path.exists(path) and not ConcurrencyContract.load(path).check(report):
+            return []  # still passing: byte-identical file, no churn
+        ConcurrencyContract.from_report(report).save(path)
+        return [
+            Finding(
+                "CONTRACT_UPDATED",
+                f"concurrency: contract written to {path}",
+                path=path,
+            )
+        ]
+    if not os.path.exists(path):
+        return [
+            Finding(
+                "CONTRACT_MISSING",
+                f"concurrency: no contract at {path} — run with "
+                "--update-contracts and commit the JSON",
+                path="concurrency",
+            )
+        ]
+    return ConcurrencyContract.load(path).check(report)

@@ -236,6 +236,76 @@ CATALOG: dict[str, CatalogEntry] = {
             "file was not analyzed at all.",
             "a file using syntax newer than the running Python",
         ),
+        # concurrency sanitizer (concurrency.py) — runtime detector
+        CatalogEntry(
+            "CONCURRENCY_CYCLE", ERROR,
+            "Lock acquisition-order cycle observed (potential deadlock)",
+            "Two code paths take the named locks in opposite orders — pick "
+            "one canonical order (or narrow one critical section so the "
+            "nested acquire disappears) and keep it.",
+            "state.singleton -> hub.write in one thread, hub.write -> "
+            "state.singleton in another",
+        ),
+        CatalogEntry(
+            "LOCK_BLOCKING_HOLD", ERROR,
+            "A named lock was held across a blocking boundary",
+            "Move the sleep/fsync/device-sync/store-I/O outside the critical "
+            "section: snapshot (or detach) the guarded state under the lock, "
+            "then block without it — every other thread needing the lock "
+            "stalls for the full blocking call otherwise.",
+            "hub.write held across os.fsync while a tracer retire waits on it",
+        ),
+        # concurrency lint rules (lint.py) — static AST pass
+        CatalogEntry(
+            "LOCK_BARE_ACQUIRE", WARNING,
+            "Bare lock.acquire() without try/finally or `with`",
+            "Use `with lock:` (or acquire immediately before a try whose "
+            "finally releases) — any exception between acquire and release "
+            "leaves the lock held forever.",
+            "self._lock.acquire() followed by fallible code with no finally",
+        ),
+        CatalogEntry(
+            "LOCK_BLOCKING_CALL", WARNING,
+            "Blocking call lexically inside a `with <lock>:` body",
+            "sleep/fsync/block_until_ready/store-I/O under a lock serializes "
+            "every waiter behind the blocking call — do the blocking work "
+            "outside the critical section on a local snapshot.",
+            "time.sleep(0.1) inside `with self._lock:`",
+        ),
+        CatalogEntry(
+            "THREAD_SHARED_MUTATION", WARNING,
+            "A thread target mutates attributes also written unguarded elsewhere",
+            "Guard the shared attribute with one lock on both sides, or make "
+            "the cross-thread signal a threading.Event — unsynchronized "
+            "read-modify-write from two threads is a data race (waive when "
+            "the attribute is a monotonic flag with benign races).",
+            "threading.Thread(target=self._run) where _run and step() both "
+            "write self.state without a lock",
+        ),
+        CatalogEntry(
+            "ASYNC_NP_VIEW", WARNING,
+            "A mutable buffer view passed to async jit dispatch",
+            "Pass a copy (`table[slot].copy()`): jit dispatch returns before "
+            "the device read finishes, so a host-side write to the same "
+            "buffer races the in-flight transfer (the PR 9 page-table race).",
+            "jitted_step(self.tables[slot]) while another path assigns "
+            "self.tables[slot][...] in place",
+        ),
+        CatalogEntry(
+            "LOCK_UNREGISTERED", WARNING,
+            "A raw threading.Lock() bypasses the named-lock registry",
+            "Construct it via analysis.concurrency.named_lock(\"subsystem."
+            "purpose\") so the lock-order detector and the concurrency "
+            "contract's inventory can see it.",
+            "self._lock = threading.Lock() instead of named_lock(...)",
+        ),
+        CatalogEntry(
+            "LINT_WAIVER_UNUSED", WARNING,
+            "A lint waiver pragma suppresses nothing",
+            "Delete the stale pragma — left in place it would silently mask "
+            "the next real finding on that line.",
+            "a stale disable=HOST_CAST pragma on a line with no finding",
+        ),
     ]
 }
 

@@ -16,11 +16,21 @@ Scope: functions the AST can see entering a traced context —
   and this repo's ``accelerator.compiled_step``/``accelerator.backward``;
 - any function/lambda nested inside one of the above (nested defs trace too).
 
-Waivers: a trailing ``# accel-lint: disable=CODE[,CODE]`` comment waives that
-line; on a ``def`` line it waives the whole function. ``disable=all`` waives
-every code. Waivers are the commit-reviewed escape hatch — the CI gate
-(tests/test_analysis.py) runs this lint over ``accelerate_tpu/`` and
-``examples/`` and fails on any *unwaived* finding.
+A second, module-wide family of rules covers host-side *concurrency*
+hazards (no traced context required): bare ``lock.acquire()`` without
+try/finally, blocking calls lexically inside a ``with <lock>:`` body,
+``threading.Thread`` targets mutating attributes also written unguarded
+elsewhere in the class, mutable buffer views passed to async jit dispatch,
+and raw ``threading.Lock()`` constructions that bypass the
+``analysis.concurrency.named_lock`` registry.
+
+Waivers: a trailing ``# accel-lint: disable=<CODE>[,<CODE>]`` comment waives
+that line; on a ``def`` line it waives the whole function. ``disable=all``
+waives every code. Waivers are the commit-reviewed escape hatch — the CI
+gate (tests/test_analysis.py) runs this lint over ``accelerate_tpu/`` and
+``examples/`` and fails on any *unwaived* finding — and they are audited:
+a pragma that suppresses nothing reports ``LINT_WAIVER_UNUSED`` so a stale
+waiver can't silently mask the next regression at that line.
 """
 
 from __future__ import annotations
@@ -48,6 +58,18 @@ _SYNC_NP_CALLS = {"asarray", "array", "copy"}
 _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear", "update",
              "add", "discard", "setdefault", "popitem"}
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# the concurrency rule family (`accelerate-tpu analyze --races`): module-wide
+# host-threading hazards, not scoped to traced roots
+CONCURRENCY_LINT_CODES = {
+    "LOCK_BARE_ACQUIRE",
+    "LOCK_BLOCKING_CALL",
+    "THREAD_SHARED_MUTATION",
+    "ASYNC_NP_VIEW",
+    "LOCK_UNREGISTERED",
+}
+# a with-item whose terminal name matches this is treated as a lock guard
+_LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
 
 
 def _callable_name(node: ast.AST) -> Optional[str]:
@@ -113,6 +135,22 @@ class _Linter:
         self.traced_roots: list = []
         self.findings: list[Finding] = []
         self._seen: set[tuple] = set()
+        # pragma lines that actually suppressed a finding — the rest are
+        # stale and report LINT_WAIVER_UNUSED at the end of the run
+        self.used_waiver_lines: set[int] = set()
+        # names assigned from named_lock(...) count as lockish even when the
+        # variable name itself doesn't say so
+        self._named_lock_names: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _callable_name(node.value.func) == "named_lock"
+            ):
+                for target in node.targets:
+                    term = self._terminal_name(target)
+                    if term:
+                        self._named_lock_names.add(term)
 
     # -- waivers -----------------------------------------------------------
 
@@ -131,6 +169,7 @@ class _Linter:
                 continue
             codes = self.waivers.get(line)
             if codes and (code in codes or "ALL" in codes):
+                self.used_waiver_lines.add(line)
                 return True
         return False
 
@@ -378,10 +417,318 @@ class _Linter:
                 root,
             )
 
+    # -- concurrency rules (module-wide, not traced-root-scoped) -------------
+
+    @staticmethod
+    def _terminal_name(node: ast.AST) -> Optional[str]:
+        """`self.cache.tables` -> "tables"; `x` -> "x"."""
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _is_lockish(self, node: ast.AST) -> bool:
+        term = self._terminal_name(node)
+        return bool(term) and (
+            bool(_LOCKISH_RE.search(term)) or term in self._named_lock_names
+        )
+
+    @staticmethod
+    def _walk_skip_funcs(stmts):
+        """Walk statements WITHOUT descending into nested function/lambda
+        bodies — code in those runs later, not under the enclosing lock."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (*_FuncNode, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _blocking_kind(call: ast.Call) -> Optional[str]:
+        chain = _attr_chain(call.func)
+        if not chain:
+            return None
+        last = chain[0] if len(chain) == 1 else chain[-1]
+        if last == "sleep" and (len(chain) == 1 or chain[0] in ("time",)):
+            return "time.sleep"
+        if last == "fsync":
+            return "os.fsync"
+        if last == "block_until_ready":
+            return "block_until_ready"
+        if last == "device_get" and (len(chain) == 1 or chain[0] == "jax"):
+            return "jax.device_get"
+        if last == "probe_io":
+            return "store I/O probe"
+        if (
+            last == "join"
+            and isinstance(call.func, ast.Attribute)
+            and not call.args
+            and not call.keywords
+        ):
+            # zero-arg .join() is a thread/queue join (str.join takes an arg)
+            return ".join()"
+        return None
+
+    def _statement_lists(self):
+        for node in ast.walk(self.tree):
+            for fieldname in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, fieldname, None)
+                if isinstance(stmts, list) and stmts:
+                    yield stmts
+
+    @staticmethod
+    def _lock_method_chain(call: ast.Call, method: str) -> Optional[str]:
+        """`self._lock.acquire()` -> "self._lock" when method matches."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == method:
+            chain = _attr_chain(func.value)
+            if chain:
+                return ".".join(chain)
+        return None
+
+    def _check_bare_acquires(self) -> None:
+        acquires: list[tuple[ast.Call, str]] = []  # bare-statement acquires
+        for stmts in self._statement_lists():
+            for stmt in stmts:
+                if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                    chain = self._lock_method_chain(stmt.value, "acquire")
+                    if chain:
+                        acquires.append((stmt.value, chain))
+        if not acquires:
+            return
+        protected: set[int] = set()
+        releases_of = {}  # Try node id -> set of released chains in finalbody
+
+        def finalbody_releases(try_node: ast.Try) -> set:
+            released = set()
+            for node in self._walk_skip_funcs(try_node.finalbody):
+                if isinstance(node, ast.Call):
+                    chain = self._lock_method_chain(node, "release")
+                    if chain:
+                        released.add(chain)
+            return released
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Try):
+                releases_of[id(node)] = finalbody_releases(node)
+                for sub in self._walk_skip_funcs(node.body):
+                    if isinstance(sub, ast.Call):
+                        chain = self._lock_method_chain(sub, "acquire")
+                        if chain and chain in releases_of[id(node)]:
+                            protected.add(id(sub))
+        # `lock.acquire()` immediately followed by a try releasing it in
+        # finally is the other canonical safe shape
+        for stmts in self._statement_lists():
+            for i, stmt in enumerate(stmts[:-1]):
+                nxt = stmts[i + 1]
+                if (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(nxt, ast.Try)
+                ):
+                    chain = self._lock_method_chain(stmt.value, "acquire")
+                    if chain and chain in releases_of.get(id(nxt), set()):
+                        protected.add(id(stmt.value))
+        for call, chain in acquires:
+            if id(call) not in protected:
+                self._add(
+                    "LOCK_BARE_ACQUIRE", call.lineno,
+                    f"bare {chain}.acquire() with no try/finally release — "
+                    "an exception before release() wedges every waiter; use "
+                    f"`with {chain}:`",
+                    None,
+                )
+
+    def _check_blocking_under_lock(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_items = [
+                item for item in node.items if self._is_lockish(item.context_expr)
+            ]
+            if not lock_items:
+                continue
+            lock_desc = ".".join(_attr_chain(lock_items[0].context_expr)) or "lock"
+            for sub in self._walk_skip_funcs(node.body):
+                if isinstance(sub, ast.Call):
+                    kind = self._blocking_kind(sub)
+                    if kind:
+                        self._add(
+                            "LOCK_BLOCKING_CALL", sub.lineno,
+                            f"`{kind}` called while holding `{lock_desc}` — "
+                            "every thread waiting on the lock stalls for the "
+                            "full blocking call",
+                            None,
+                        )
+
+    def _unguarded_self_writes(self, method) -> set:
+        """Attribute names stored to ``self`` in this method OUTSIDE any
+        ``with <lockish>:`` block (lexically)."""
+        writes: set[str] = set()
+
+        def visit(node, guarded: bool) -> None:
+            if isinstance(node, (*_FuncNode, ast.Lambda)) and node is not method:
+                return
+            if isinstance(node, ast.With) and any(
+                self._is_lockish(item.context_expr) for item in node.items
+            ):
+                guarded = True
+            if not guarded and isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        writes.add(target.attr)
+            for child in ast.iter_child_nodes(node):
+                visit(child, guarded)
+
+        visit(method, False)
+        return writes
+
+    def _check_thread_shared_mutation(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {m.name: m for m in cls.body if isinstance(m, _FuncNode)}
+            for node in ast.walk(cls):
+                if not (
+                    isinstance(node, ast.Call)
+                    and _callable_name(node.func) == "Thread"
+                ):
+                    continue
+                target_name = None
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"
+                    ):
+                        target_name = kw.value.attr
+                method = methods.get(target_name) if target_name else None
+                if method is None:
+                    continue
+                thread_writes = self._unguarded_self_writes(method)
+                other_writes: set[str] = set()
+                for name, other in methods.items():
+                    if name not in (target_name, "__init__"):
+                        other_writes |= self._unguarded_self_writes(other)
+                shared = sorted(thread_writes & other_writes)
+                if shared:
+                    self._add(
+                        "THREAD_SHARED_MUTATION", node.lineno,
+                        f"thread target {cls.name}.{target_name} writes "
+                        f"{shared} which other methods also write outside "
+                        "any lock — unsynchronized cross-thread mutation",
+                        None,
+                    )
+
+    def _check_async_np_views(self) -> None:
+        jitted: set[str] = set()
+        mutated_bases: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Call) and _is_jit_like(node.value):
+                    for target in node.targets:
+                        term = self._terminal_name(target)
+                        if term:
+                            jitted.add(term)
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        term = self._terminal_name(target.value)
+                        if term:
+                            mutated_bases.add(term)
+            elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Subscript):
+                term = self._terminal_name(node.target.value)
+                if term:
+                    mutated_bases.add(term)
+        if not jitted:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = self._terminal_name(node.func)
+            if fname not in jitted:
+                continue
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                if isinstance(arg, ast.Subscript):
+                    base = self._terminal_name(arg.value)
+                    if base in mutated_bases:
+                        self._add(
+                            "ASYNC_NP_VIEW", arg.lineno,
+                            f"view `{base}[...]` passed to jitted `{fname}` "
+                            "while the same buffer is mutated in place in "
+                            "this file — the async dispatch may read the "
+                            "mutated bytes; pass a .copy()",
+                            None,
+                        )
+
+    def _check_unregistered_locks(self) -> None:
+        imported_lock_names: set[str] = set()
+        safe_ctor_ids: set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in ("Lock", "RLock"):
+                        imported_lock_names.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Call) and _callable_name(node.func) == "named_lock":
+                for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                    for sub in ast.walk(arg):
+                        safe_ctor_ids.add(id(sub))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or id(node) in safe_ctor_ids:
+                continue
+            chain = _attr_chain(node.func)
+            is_ctor = chain[-2:] in (["threading", "Lock"], ["threading", "RLock"]) or (
+                len(chain) == 1 and chain[0] in imported_lock_names
+            )
+            if is_ctor:
+                self._add(
+                    "LOCK_UNREGISTERED", node.lineno,
+                    f"raw {'.'.join(chain)}() bypasses the named-lock "
+                    "registry — construct it via analysis.concurrency."
+                    'named_lock("subsystem.purpose")',
+                    None,
+                )
+
+    def check_concurrency(self) -> None:
+        self._check_bare_acquires()
+        self._check_blocking_under_lock()
+        self._check_thread_shared_mutation()
+        self._check_async_np_views()
+        self._check_unregistered_locks()
+
+    # -- the waiver audit ----------------------------------------------------
+
+    def _audit_waivers(self) -> None:
+        """Runs LAST: any pragma line that suppressed nothing is stale. A
+        pragma that waives LINT_WAIVER_UNUSED itself is exempt (the reviewed
+        way to keep a deliberate placeholder)."""
+        for line, codes in sorted(self.waivers.items()):
+            if line in self.used_waiver_lines or "LINT_WAIVER_UNUSED" in codes:
+                continue
+            self._add(
+                "LINT_WAIVER_UNUSED", line,
+                f"waiver pragma (disable={','.join(sorted(codes))}) "
+                "suppresses no finding at this line — delete it before it "
+                "masks a real one",
+                None,
+            )
+
     def run(self) -> list[Finding]:
         self.discover()
         for root in self.traced_roots:
             self.check_root(root)
+        self.check_concurrency()
+        self._audit_waivers()
         self.findings.sort(key=lambda f: f.path or "")
         return self.findings
 
@@ -424,13 +771,17 @@ def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
                         yield os.path.join(dirpath, filename)
 
 
-def lint_paths(paths: Iterable[str]) -> AnalysisReport:
+def lint_paths(paths: Iterable[str], only: Optional[set] = None) -> AnalysisReport:
     """Lint every ``.py`` under the given files/directories. The report's
-    inventory counts files scanned and traced functions found."""
+    inventory counts files scanned and traced functions found. ``only``
+    restricts the report to a set of finding codes (e.g.
+    ``CONCURRENCY_LINT_CODES`` for ``analyze --races``)."""
     report = AnalysisReport(meta={"label": "lint"})
     files = 0
     for path in iter_python_files(paths):
         files += 1
         report.extend(lint_file(path))
+    if only is not None:
+        report.findings = [f for f in report.findings if f.code in only]
     report.inventory = {"files_scanned": files, "findings": len(report.findings)}
     return report

@@ -45,11 +45,12 @@ import threading
 import traceback
 from typing import Any, Callable, Optional
 
+from .concurrency import named_lock
 from .findings import AnalysisReport, Finding
 
 _HOOK_NAMES = ("__array__", "__float__", "__int__", "__bool__", "__index__", "item", "tolist")
 
-_patch_lock = threading.Lock()
+_patch_lock = named_lock("sanitizer.patch")
 _patch_depth = 0
 _patch_originals: dict[str, Any] = {}
 _active_sanitizers: list["HazardSanitizer"] = []

@@ -10,6 +10,13 @@ Three modes that compose:
 
        accelerate-tpu analyze train.py my_pkg/ --strict
 
+   ``--races`` narrows the lint to the concurrency rule family only (bare
+   ``lock.acquire()``, blocking calls inside ``with <lock>:``, unguarded
+   thread-shared mutation, numpy views into async dispatch, raw
+   ``threading.Lock()`` bypassing the named-lock registry)::
+
+       accelerate-tpu analyze --races accelerate_tpu/
+
 2. **Self-check** (``--self-check``): build the repo's own canonical
    programs — the bert-tiny fused step and a llama-tiny FSDP step (both
    compile the ZeRO sharded-update variant by default: all-gather →
@@ -22,7 +29,11 @@ Three modes that compose:
    device-program drift), and the routed 2-replica decode path — and run
    the full compiled-program audit
    (donation aliasing, fp64, constants, collective inventory, replication,
-   HBM memory, collective-overlap schedule) over each::
+   HBM memory, collective-overlap schedule) over each. The concurrency
+   drill rides along: the traced 2-replica fleet + an elastic coordinator
+   run under the lock-order recorder (analysis/concurrency.py) and the
+   resulting lock graph is reported — and gated, under ``--contracts``, by
+   ``tests/contracts/concurrency.json``::
 
        accelerate-tpu analyze --self-check
 
@@ -79,6 +90,12 @@ def register_subcommand(subparsers):
     parser.add_argument(
         "--contracts-dir", default=None,
         help="Contract directory (default: the repo's tests/contracts)",
+    )
+    parser.add_argument(
+        "--races", action="store_true",
+        help="Lint only the concurrency rule family (bare acquires, blocking "
+        "calls under locks, unguarded thread-shared state, numpy views into "
+        "async dispatch, unregistered raw locks) over the given paths",
     )
     parser.add_argument("--json", action="store_true", help="Emit the machine-readable report")
     parser.add_argument(
@@ -314,6 +331,83 @@ def _self_check(compile: bool):
     return reports
 
 
+def _concurrency_drill():
+    """The thread-richest real paths, run under the lock-order recorder
+    (analysis/concurrency.py): an elastic coordinator with the membership
+    failure detector armed (2 simulated hosts), the routed 2-replica traced
+    fleet, a sanitizer window, the redistribute sequencer, and the telemetry
+    hub's flush path. Every named lock the codebase owns registers along the
+    way, so the resulting report's lock inventory + acquisition-order graph
+    is the artifact ``tests/contracts/concurrency.json`` gates: zero cycles,
+    zero blocking-under-lock, exact lock set."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from ..analysis import HazardSanitizer, concurrency
+    from ..models import Bert, Llama
+    from ..parallel.redistribute import reset_transfer_seq
+    from ..resilience.elastic import ElasticConfig
+    from ..resilience.membership import DictStore, MembershipConfig, MembershipService
+    from ..serving import ServingEngine, ServingRouter
+    from ..telemetry.tracing import RequestTracer
+
+    concurrency.reset_observations()
+    prior_dir = os.environ.get("ACCELERATE_TELEMETRY_DIR")
+    with tempfile.TemporaryDirectory() as tmp:
+        # any telemetry the drill's subsystems emit lands in the tmp dir,
+        # never the caller's cwd
+        os.environ["ACCELERATE_TELEMETRY_DIR"] = tmp
+        try:
+            with concurrency.record():
+                accelerator, model, batch = canonical_bert_program()
+                membership = MembershipService(
+                    DictStore(), num_hosts=2, host_index=0,
+                    # long timeouts: a seconds-scale drill must never
+                    # manufacture a loss detection
+                    config=MembershipConfig(hang_watchdog_timeout_s=60.0),
+                )
+                coordinator = accelerator.elastic_coordinator(
+                    Bert.loss_fn(model),
+                    config=ElasticConfig(redundancy=0, num_hosts=2),
+                    membership=membership,
+                )
+                for _ in range(2):
+                    coordinator.step(batch)
+
+                llama = Llama("llama-tiny")
+                lparams = llama.init(jax.random.key(0))
+                router = ServingRouter(
+                    engine_factory=lambda: ServingEngine(
+                        llama, lparams, num_slots=2, max_len=32, page_size=16
+                    ),
+                    num_replicas=2,
+                    tracer=RequestTracer(),
+                )
+                rng = np.random.default_rng(0)
+                prompts = [
+                    rng.integers(0, llama.config.vocab_size, (4,)).astype(np.int32)
+                    for _ in range(2)
+                ]
+                router.generate_many(prompts, max_new_tokens=2)
+
+                with HazardSanitizer(label="concurrency-drill"):
+                    pass
+                reset_transfer_seq()
+                # the hub's flush path is the satellite-6 regression target:
+                # finish() must not hold hub.write across the fsync
+                accelerator.telemetry.finish()
+        finally:
+            if prior_dir is None:
+                os.environ.pop("ACCELERATE_TELEMETRY_DIR", None)
+            else:
+                os.environ["ACCELERATE_TELEMETRY_DIR"] = prior_dir
+    return concurrency.registry().report()
+
+
 def run(args) -> int:
     from ..analysis import AnalysisReport, lint_paths
 
@@ -329,21 +423,39 @@ def run(args) -> int:
 
     reports: list[AnalysisReport] = []
     if args.paths:
-        reports.append(lint_paths(args.paths))
+        if getattr(args, "races", False):
+            from ..analysis.lint import CONCURRENCY_LINT_CODES
+
+            reports.append(lint_paths(args.paths, only=CONCURRENCY_LINT_CODES))
+        else:
+            reports.append(lint_paths(args.paths))
     if args.self_check:
         reports.extend(_self_check(compile=not args.no_compile))
+        # the concurrency drill rides on every self-check: the traced fleet +
+        # elastic coordinator run under the lock-order recorder and the
+        # resulting lock graph is a first-class report (and, under
+        # --contracts, gated by tests/contracts/concurrency.json)
+        reports.append(_concurrency_drill())
     if not reports:
         print("nothing to analyze: pass paths to lint and/or --self-check")
         return 1
 
     contract_notes = []
     if contracts_mode:
+        from ..analysis.concurrency import gate_concurrency
         from ..analysis.contracts import default_contracts_dir, gate_reports
 
         contracts_dir = args.contracts_dir or default_contracts_dir()
         contract_notes = gate_reports(
             reports, contracts_dir, update=args.update_contracts
         )
+        for report in reports:
+            if report.meta.get("kind") == "concurrency":
+                notes = gate_concurrency(
+                    report, contracts_dir, update=args.update_contracts
+                )
+                report.extend(notes)
+                contract_notes.extend(notes)
 
     total_findings = 0
     total_errors = 0

@@ -79,6 +79,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis.concurrency import named_lock
 from ..logging import get_logger
 
 logger = get_logger(__name__)
@@ -544,7 +545,7 @@ def _staged_leaf(leaf, dst_sharding, leaf_stages, fire: Callable[[Stage], None])
 # the transfer transaction
 # ---------------------------------------------------------------------------
 
-_SEQ_LOCK = threading.Lock()
+_SEQ_LOCK = named_lock("redistribute.seq")
 _TRANSFER_SEQ = 0
 
 

@@ -97,6 +97,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..analysis.concurrency import note_blocking
 from ..logging import get_logger
 
 logger = get_logger(__name__)
@@ -503,6 +504,9 @@ def active_plan() -> Optional[FaultPlan]:
 
 def probe_io(site: str) -> None:
     """Checkpoint save/load call sites probe here; a no-op unless a plan with
-    I/O budget is active (one attribute read on the common path)."""
+    I/O budget is active (one attribute read on the common path). Always
+    tells the concurrency registry a blocking store-I/O boundary was crossed
+    so a lock held across it becomes a LOCK_BLOCKING_HOLD finding."""
+    note_blocking("store_io", site=site)
     if _active is not None:
         _active.probe_io(site)

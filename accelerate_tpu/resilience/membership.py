@@ -60,6 +60,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..analysis.concurrency import named_lock
 from ..logging import get_logger
 from .chaos import probe_io
 from .detector import SilenceDetector
@@ -228,7 +229,7 @@ class DictStore(MembershipStore):
 
     def __init__(self):
         self._data: dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("membership.store")
 
     def read(self, key: str) -> Optional[dict]:
         probe_io("membership_store")
@@ -771,7 +772,7 @@ class CollectiveHangWatchdog:
         # thread firing RIGHT at the disarm boundary can never strand an
         # orphaned stall flag: either it publishes before disarm (which
         # then retracts) or disarm wins and the late trip is suppressed
-        self._lock = threading.Lock()
+        self._lock = named_lock("membership.watchdog")
         self._armed = False
         self._published = False
         self._watchdog = StepWatchdog(self.timeout_s, self._on_hang)

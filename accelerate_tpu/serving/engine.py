@@ -215,7 +215,10 @@ class StepWatchdog:
         self.fired = False
         self._deadline = time.monotonic() + self.timeout_s
         if self._thread is None:
-            self._thread = threading.Thread(
+            self._thread = threading.Thread(  # accel-lint: disable=THREAD_SHARED_MUTATION
+                # `fired` is a monotonic False->True flag per armed window;
+                # arm() resets it only before the deadline is published, so
+                # the unlocked write race is benign by construction
                 target=self._run, name="accelerate-tpu-step-watchdog", daemon=True
             )
             self._thread.start()

@@ -26,6 +26,7 @@ import numpy as np
 
 import jax
 
+from .analysis.concurrency import named_lock
 from .logging import get_logger
 from .utils.constants import CANONICAL_MESH_AXES, MESH_AXIS_DATA
 from .utils.dataclasses import (
@@ -74,7 +75,7 @@ class PartialState:
     """
 
     _shared_state: dict[str, Any] = {}
-    _mutex = threading.Lock()
+    _mutex = named_lock("state.singleton")
 
     def __init__(self, parallelism: Optional[ParallelismConfig] = None, **kwargs: Any) -> None:
         with PartialState._mutex:

@@ -22,11 +22,13 @@ import threading
 import weakref
 from typing import Any
 
+from ..analysis.concurrency import named_lock
+
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _active_trackers: "weakref.WeakSet[CompileTracker]" = weakref.WeakSet()
 _dispatcher_installed = False
-_install_lock = threading.Lock()
+_install_lock = named_lock("compile_tracker.install")
 
 
 def _dispatch_duration(event: str, duration: float, **kwargs: Any) -> None:
@@ -63,7 +65,7 @@ class CompileTracker:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compile_tracker.events")
         self._events: dict[str, list] = {}  # name -> [count, total_seconds]
         self.cache_hits = 0
         self.cache_misses = 0

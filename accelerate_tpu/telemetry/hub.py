@@ -31,6 +31,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from ..analysis.concurrency import named_lock
 from ..logging import get_logger
 from ..utils.environment import parse_flag_from_env, parse_int_from_env
 from .compile_tracker import CompileTracker
@@ -77,7 +78,7 @@ class Telemetry:
         self._file = None
         # serving's step watchdog reports hangs from a side thread; the jsonl
         # sink must not interleave lines or double-open under that race
-        self._write_lock = threading.Lock()
+        self._write_lock = named_lock("hub.write")
         self._finished = False
         self._last_flush_step: Optional[int] = None
         self._throughput: dict[str, float] = {}
@@ -372,15 +373,19 @@ class Telemetry:
         if flush and self.timer.steps:
             self.flush(step=self.timer.steps)
         self.compiles.stop()
+        # detach the sink under the lock, then flush/fsync/close OUTSIDE
+        # it: fsync can take tens of milliseconds and a tracer retire calling
+        # write_record() must never block on a durability barrier
         with self._write_lock:
-            if self._file is not None:
-                try:
-                    self._file.flush()
-                    os.fsync(self._file.fileno())
-                except (OSError, ValueError):
-                    pass
-                self._file.close()
-                self._file = None
+            file, self._file = self._file, None
+        if file is not None:
+            try:
+                file.flush()
+                os.fsync(file.fileno())
+            except (OSError, ValueError):
+                pass
+            finally:
+                file.close()
 
     def to_json(self) -> str:
         from ..tracking import dumps_robust

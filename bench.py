@@ -2378,6 +2378,25 @@ def bench_analysis() -> dict:
     )
     result["analysis_contract_drift_count"] = drift_count(gate_findings)
     result["analysis_contract_findings"] = [str(f) for f in gate_findings]
+
+    # the concurrency drill under the lock-order recorder: cycle count must
+    # be 0 and the lock inventory size is the codebase's thread surface —
+    # both gated by tests/contracts/concurrency.json in the self-check, and
+    # surfaced here so a bench diff shows a new lock or a new hazard
+    from accelerate_tpu.analysis.concurrency import gate_concurrency
+    from accelerate_tpu.commands.analyze import _concurrency_drill
+
+    drill_report = _concurrency_drill()
+    result["analysis_concurrency_cycle_count"] = len(
+        drill_report.inventory["cycles"]
+    )
+    result["analysis_concurrency_blocking_hold_count"] = len(
+        drill_report.inventory["blocking_holds"]
+    )
+    result["analysis_lock_count"] = len(drill_report.inventory["locks"])
+    concurrency_notes = gate_concurrency(drill_report, contracts_dir, update=update)
+    result["analysis_contract_drift_count"] += drift_count(concurrency_notes)
+    result["analysis_contract_findings"] += [str(f) for f in concurrency_notes]
     return result
 
 

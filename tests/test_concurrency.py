@@ -1,0 +1,408 @@
+"""Tier-1 tests for the concurrency sanitizer (analysis/concurrency.py) and
+the concurrency lint rule family (analysis/lint.py).
+
+The two seeded fixtures here — a lock-order inversion and a sleep-under-lock
+— are the acceptance proof that the detector names real hazards
+(``CONCURRENCY_CYCLE``, ``LOCK_BLOCKING_HOLD``), and the drill tests prove
+the codebase's own 8-lock surface runs clean under the recorder and matches
+``tests/contracts/concurrency.json`` exactly. Fixture locks are ``forget()``-
+ed on the way out so they never leak into that exact inventory.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+from accelerate_tpu.analysis.concurrency import (
+    ConcurrencyContract,
+    _find_cycles,
+    gate_concurrency,
+    named_lock,
+    record,
+    registry,
+)
+from accelerate_tpu.analysis.lint import lint_source
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTRACTS_DIR = os.path.join(REPO_ROOT, "tests", "contracts")
+
+
+# -- the registry / named locks ------------------------------------------------
+
+
+def test_named_lock_basics():
+    lock = named_lock("test.basic")
+    try:
+        assert not lock.locked()
+        with lock:
+            assert lock.locked()
+            assert "test.basic" in repr(lock)
+        assert not lock.locked()
+        assert lock.acquire(blocking=False)
+        lock.release()
+        assert "test.basic" in registry().lock_names()
+    finally:
+        registry().forget("test.basic")
+    assert "test.basic" not in registry().lock_names()
+
+
+def test_held_stack_survives_out_of_order_release():
+    a, b = named_lock("test.ooo_a"), named_lock("test.ooo_b")
+    try:
+        a.acquire()
+        b.acquire()
+        a.release()  # not LIFO — the stack must pop by name, not position
+        b.release()
+        assert not a.locked() and not b.locked()
+    finally:
+        registry().forget("test.ooo_a", "test.ooo_b")
+
+
+def test_seeded_lock_inversion_detected():
+    """The acceptance fixture: A->B in one thread, B->A in another, is a
+    CONCURRENCY_CYCLE naming both locks."""
+    a, b = named_lock("test.inv_a"), named_lock("test.inv_b")
+    try:
+        registry().reset_observations()
+        with record():
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:
+                        pass
+
+            # sequential threads: the ORDER graph doesn't need a live
+            # deadlock, just both orders observed
+            for target in (forward, backward):
+                t = threading.Thread(target=target)
+                t.start()
+                t.join()
+        report = registry().report()
+        cycles = [f for f in report.findings if f.code == "CONCURRENCY_CYCLE"]
+        assert cycles, report.render()
+        assert "test.inv_a" in cycles[0].message
+        assert "test.inv_b" in cycles[0].message
+        assert report.inventory["cycles"] == [["test.inv_a", "test.inv_b"]]
+    finally:
+        registry().forget("test.inv_a", "test.inv_b")
+
+
+def test_seeded_sleep_under_lock_detected():
+    """The acceptance fixture: time.sleep inside ``with lock:`` under the
+    recorder is a LOCK_BLOCKING_HOLD naming the lock and the boundary."""
+    guard = named_lock("test.sleepy")
+    try:
+        registry().reset_observations()
+        with record():
+            with guard:
+                time.sleep(0.001)
+        report = registry().report()
+        holds = [f for f in report.findings if f.code == "LOCK_BLOCKING_HOLD"]
+        assert holds, report.render()
+        assert any(
+            "test.sleepy" in f.message and "time.sleep" in f.message for f in holds
+        )
+    finally:
+        registry().forget("test.sleepy")
+
+
+def test_blocking_without_lock_is_clean():
+    registry().reset_observations()
+    with record():
+        time.sleep(0.001)  # no lock held: not a hold
+    report = registry().report()
+    assert [f for f in report.findings if f.code == "LOCK_BLOCKING_HOLD"] == []
+
+
+def test_record_restores_patches_on_exit():
+    original_sleep, original_fsync = time.sleep, os.fsync
+    with record():
+        assert time.sleep is not original_sleep
+        assert os.fsync is not original_fsync
+    assert time.sleep is original_sleep
+    assert os.fsync is original_fsync
+
+
+def test_recording_off_records_no_edges():
+    a, b = named_lock("test.off_a"), named_lock("test.off_b")
+    try:
+        registry().reset_observations()
+        with a:
+            with b:
+                pass
+        assert ("test.off_a", "test.off_b") not in registry().edges()
+    finally:
+        registry().forget("test.off_a", "test.off_b")
+
+
+def test_find_cycles_unit():
+    assert _find_cycles({("A", "B"), ("B", "A")}) == [["A", "B"]]
+    assert _find_cycles({("A", "B"), ("B", "C"), ("C", "A")}) == [["A", "B", "C"]]
+    assert _find_cycles({("A", "B"), ("B", "C")}) == []
+
+
+# -- the contract --------------------------------------------------------------
+
+
+def _seeded_report(locks=("x",), cycles=(), blocking=()):
+    from accelerate_tpu.analysis.findings import AnalysisReport
+
+    report = AnalysisReport(meta={"label": "concurrency", "kind": "concurrency"})
+    report.inventory = {
+        "locks": sorted(locks),
+        "cycles": [list(c) for c in cycles],
+        "blocking_holds": [
+            {"lock": lock, "kind": kind, "count": 1} for lock, kind in blocking
+        ],
+    }
+    return report
+
+
+def test_contract_roundtrip_and_drift(tmp_path):
+    report = _seeded_report(locks=["a", "b"])
+    contract = ConcurrencyContract.from_report(report)
+    path = str(tmp_path / "concurrency.json")
+    contract.save(path)
+    loaded = ConcurrencyContract.load(path)
+    assert loaded.check(report) == []
+
+    drifted = loaded.check(_seeded_report(locks=["a", "b", "c"]))
+    assert [f.path for f in drifted] == ["concurrency:locks"]
+    assert "new locks ['c']" in drifted[0].message
+
+    drifted = loaded.check(
+        _seeded_report(locks=["a", "b"], cycles=[["a", "b"]], blocking=[("a", "time.sleep")])
+    )
+    assert sorted(f.path for f in drifted) == [
+        "concurrency:blocking_holds",
+        "concurrency:cycles",
+    ]
+
+
+def test_gate_concurrency_update_is_churn_free(tmp_path):
+    report = _seeded_report(locks=["a"])
+    notes = gate_concurrency(report, str(tmp_path), update=True)
+    assert [f.code for f in notes] == ["CONTRACT_UPDATED"]
+    written = (tmp_path / "concurrency.json").read_bytes()
+    # second update with an undrifted report: byte-identical, no note
+    assert gate_concurrency(report, str(tmp_path), update=True) == []
+    assert (tmp_path / "concurrency.json").read_bytes() == written
+    assert gate_concurrency(report, str(tmp_path)) == []
+
+
+def test_gate_concurrency_missing_contract(tmp_path):
+    notes = gate_concurrency(_seeded_report(), str(tmp_path))
+    assert [f.code for f in notes] == ["CONTRACT_MISSING"]
+
+
+# -- the lint rule family ------------------------------------------------------
+
+
+def test_lint_bare_acquire_flagged_and_guarded_forms_clean():
+    bad = "def f(lock):\n    lock.acquire()\n    work()\n"
+    assert [f.code for f in lint_source(bad)] == ["LOCK_BARE_ACQUIRE"]
+    good = (
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        lock.release()\n"
+    )
+    assert lint_source(good) == []
+    with_form = "def f(lock):\n    with lock:\n        work()\n"
+    assert lint_source(with_form) == []
+
+
+def test_lint_blocking_call_under_lock():
+    bad = (
+        "import time\n"
+        "def f(self):\n"
+        "    with self._write_lock:\n"
+        "        time.sleep(1)\n"
+    )
+    assert [f.code for f in lint_source(bad)] == ["LOCK_BLOCKING_CALL"]
+    # a nested def under the lock runs LATER, off the lock's critical section
+    deferred = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        def later():\n"
+        "            time.sleep(1)\n"
+        "        schedule(later)\n"
+    )
+    assert lint_source(deferred) == []
+    # named_lock-assigned names are lockish even without 'lock' in the name
+    named = (
+        "from accelerate_tpu.analysis.concurrency import named_lock\n"
+        "guard = named_lock('a.b')\n"
+        "def f(fd):\n"
+        "    import os\n"
+        "    with guard:\n"
+        "        os.fsync(fd)\n"
+    )
+    assert [f.code for f in lint_source(named)] == ["LOCK_BLOCKING_CALL"]
+
+
+def test_lint_thread_shared_mutation():
+    bad = (
+        "import threading\n"
+        "class W:\n"
+        "    def _run(self):\n"
+        "        self.fired = True\n"
+        "    def arm(self):\n"
+        "        self.fired = False\n"
+        "        threading.Thread(target=self._run).start()\n"
+    )
+    assert [f.code for f in lint_source(bad)] == ["THREAD_SHARED_MUTATION"]
+    guarded = (
+        "import threading\n"
+        "class W:\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.fired = True\n"
+        "    def arm(self):\n"
+        "        with self._lock:\n"
+        "            self.fired = False\n"
+        "        threading.Thread(target=self._run).start()\n"
+    )
+    assert lint_source(guarded) == []
+
+
+def test_lint_async_np_view():
+    bad = (
+        "import jax\n"
+        "step = jax.jit(fn)\n"
+        "def loop(pages):\n"
+        "    pages[0] = 1\n"
+        "    step(pages[0])\n"
+    )
+    assert [f.code for f in lint_source(bad)] == ["ASYNC_NP_VIEW"]
+    copied = bad.replace("step(pages[0])", "step(pages[0].copy())")
+    assert lint_source(copied) == []
+
+
+def test_lint_unregistered_lock():
+    bad = "import threading\nlock = threading.Lock()\n"
+    assert [f.code for f in lint_source(bad)] == ["LOCK_UNREGISTERED"]
+    wrapped = (
+        "import threading\n"
+        "from accelerate_tpu.analysis.concurrency import named_lock\n"
+        "lock = named_lock('x.y', inner=threading.Lock())\n"
+    )
+    assert lint_source(wrapped) == []
+
+
+def test_lint_unused_waiver_audited():
+    stale = "x = 1  # accel-lint: disable=HOST_RNG_IN_TRACE\n"
+    assert [f.code for f in lint_source(stale)] == ["LINT_WAIVER_UNUSED"]
+    used = (
+        "import threading\n"
+        "lock = threading.Lock()  # accel-lint: disable=LOCK_UNREGISTERED\n"
+    )
+    assert lint_source(used) == []
+
+
+# -- HazardSanitizer patch plumbing under concurrency --------------------------
+
+
+def test_sanitizer_concurrent_enter_exit_two_threads():
+    """Satellite: _install_patches/_remove_patches refcount under two
+    threads opening and closing sanitizer windows concurrently — depth must
+    come back to zero and every patched attribute must be restored."""
+    import jax
+
+    from accelerate_tpu.analysis import sanitizer as san
+
+    original_device_get = jax.device_get
+    errors: list = []
+
+    def worker():
+        try:
+            for _ in range(25):
+                with san.HazardSanitizer(label="t"):
+                    pass
+        except Exception as exc:  # pragma: no cover - failure detail
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert san._patch_depth == 0
+    assert jax.device_get is original_device_get
+    assert san._patch_originals == {}
+
+
+# -- the drill + gate ----------------------------------------------------------
+
+
+def test_drill_runs_clean_and_matches_contract():
+    """The real fleet + elastic chaos-path drill under the recorder: zero
+    cycles, zero blocking holds (the hub fsync fix is load-bearing here —
+    finish() under the old shape held hub.write across os.fsync), and the
+    lock inventory matches the checked-in contract exactly."""
+    from accelerate_tpu.commands.analyze import _concurrency_drill
+
+    report = _concurrency_drill()
+    assert report.findings == [], report.render()
+    assert report.inventory["cycles"] == []
+    assert report.inventory["blocking_holds"] == []
+    assert report.inventory["acquisitions"] > 0
+    assert gate_concurrency(report, CONTRACTS_DIR) == [], report.inventory["locks"]
+
+    contract = ConcurrencyContract.load(
+        os.path.join(CONTRACTS_DIR, "concurrency.json")
+    )
+    assert contract.cycles == 0
+    assert contract.blocking_holds == 0
+    assert len(contract.locks) == 8
+
+
+def test_hub_finish_does_not_hold_lock_across_fsync(tmp_path):
+    """Regression pin for the satellite-6 fix: the hub's finish() path
+    flushes + fsyncs OUTSIDE hub.write. Under the recorder, a write + finish
+    must produce no blocking hold attributed to hub.write."""
+    from accelerate_tpu.telemetry.hub import Telemetry, TelemetryConfig
+
+    registry().reset_observations()
+    with record():
+        hub = Telemetry(
+            config=TelemetryConfig(enabled=True, dir=str(tmp_path), flush_every=0)
+        )
+        hub.write_record("test", {"payload": 1})
+        hub.finish()
+    held = [b for b in registry().blocking_holds() if b["lock"] == "hub.write"]
+    assert held == [], held
+    registry().reset_observations()
+
+
+def test_cli_exits_1_on_tampered_concurrency_contract(tmp_path, capsys):
+    """End-to-end: a contracts dir whose concurrency.json expects a lock
+    that does not exist must fail `analyze --self-check --contracts` with
+    exit 1, naming the drifted field."""
+    tampered_dir = tmp_path / "contracts"
+    shutil.copytree(CONTRACTS_DIR, tampered_dir)
+    path = tampered_dir / "concurrency.json"
+    payload = json.loads(path.read_text())
+    payload["expectations"]["locks"].append("ghost.lock")
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+    from accelerate_tpu.commands.cli import main
+
+    rc = main(
+        ["analyze", "--self-check", "--no-compile", "--contracts",
+         "--contracts-dir", str(tampered_dir)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "concurrency:locks" in out
+    assert "ghost.lock" in out
